@@ -1,0 +1,50 @@
+"""bagua_tpu — a TPU-native distributed training acceleration framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of Bagua
+(github.com/Youhe-Jiang/bagua, surveyed in /root/repo/SURVEY.md): pluggable
+communication *algorithms* (centralized / decentralized / low-precision /
+asynchronous / MoE expert-parallel) decoupled from the communication substrate,
+which here is XLA collectives over ICI/DCN on a named device mesh instead of a
+Rust scheduler driving NCCL streams.
+"""
+
+from .version import __version__  # noqa: F401
+
+from . import env  # noqa: F401
+from .communication import (  # noqa: F401
+    BaguaBackend,
+    BaguaCommunicator,
+    ReduceOp,
+    allgather,
+    allgather_inplace,
+    allreduce,
+    allreduce_inplace,
+    alltoall,
+    alltoall_inplace,
+    barrier,
+    broadcast,
+    gather,
+    get_backend,
+    init_process_group,
+    reduce,
+    reduce_scatter,
+    reduce_scatter_inplace,
+    scatter,
+    send_recv,
+)
+from .bucket import BucketPlan, BucketSpec, split_bucket_by_bucket_size  # noqa: F401
+from .core.backend import BaguaTrainer, TrainState  # noqa: F401
+from .define import BaguaHyperparameter, TensorDeclaration, TensorDtype  # noqa: F401
+from .env import (  # noqa: F401
+    get_local_rank,
+    get_local_size,
+    get_rank,
+    get_world_size,
+)
+from .parallel.mesh import (  # noqa: F401
+    build_mesh,
+    get_global_mesh,
+    hierarchical_mesh,
+    set_global_mesh,
+)
+from .tensor import NamedParam, build_params  # noqa: F401

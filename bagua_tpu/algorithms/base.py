@@ -1,0 +1,127 @@
+"""Algorithm base class — the pluggable "what/when to communicate" contract.
+
+Counterpart of /root/reference/bagua/torch_api/algorithms/base.py:8-156.  The
+reference's 7 hooks are driven by autograd events (grad-ready marks, post
+backward, post optimizer step); under XLA the whole train step is one traced
+program, so the hooks become *functional stages* of the step:
+
+  reference hook                        bagua_tpu stage
+  ------------------------------------  ----------------------------------
+  init_tensors / tensors_to_buckets     init_tensors / tensors_to_buckets (same)
+  init_forward_pre_hook (mark ready)    (implicit: XLA schedules collectives)
+  init_backward_hook (per-grad mark)    process_grads (bucketed comm on grads)
+  init_post_backward_hook (wait ops)    process_pre_step (weight comm lands here)
+  init_post_optimizer_step_hook         process_post_step
+  init_operations                       the body of the stages above
+  need_reset                            need_reset (host-side, triggers rebuild)
+
+All stages except ``need_reset``/``init_tensors``/``tensors_to_buckets`` are
+traced inside ``shard_map`` over the data-parallel mesh axes and may call
+collectives through ``ctx``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..bucket import BucketPlan
+from ..communication import BaguaCommunicator, ReduceOp
+from ..define import TensorDeclaration
+from ..tensor import NamedParam
+
+
+@dataclass
+class AlgorithmContext:
+    """Static per-compile context handed to traced algorithm stages."""
+
+    comm: BaguaCommunicator              # spans all dp axes ("global")
+    internode: Optional[BaguaCommunicator]
+    intranode: Optional[BaguaCommunicator]
+    plan: BucketPlan
+    world_size: int
+
+    def hierarchical_allreduce(self, flat, op: ReduceOp, hierarchical: bool):
+        """Hierarchical = intra-node stage then inter-node stage, the reference's
+        Leader/Worker pattern (communicators/mod.rs:243-336) collapsed into
+        nested mesh-axis collectives (XLA routes intra over ICI, inter over DCN)."""
+        if (
+            hierarchical
+            and self.internode is not None
+            and self.intranode is not None
+            and self.internode is not self.intranode
+        ):
+            flat = self.intranode.allreduce(flat, op)
+            return self.internode.allreduce(flat, op)
+        return self.comm.allreduce(flat, op)
+
+
+class Algorithm:
+    """Base algorithm: plain distributed data parallelism hooks.
+
+    Subclasses override stages; the default implementation is a no-op pass
+    (gradients unchanged), matching the reference's ``Algorithm`` which only
+    wires default bucketing/marking (base.py:24-125).
+    """
+
+    #: False for gossip-style algorithms whose weights differ across ranks;
+    #: the trainer then keeps params/opt/algo state stacked per rank.
+    replicated_params: bool = True
+    #: True when the algorithm provides its own optimizer update (QAdam).
+    owns_optimizer: bool = False
+    #: Alignment for bucket padding (compressed ops need world_size).
+    bucket_alignment: int = 1
+    #: Hierarchical (intra-node then inter-node) communication.
+    hierarchical: bool = False
+
+    def need_reset(self, step: int) -> bool:
+        """Host-side: return True to rebuild buckets/recompile (reference
+        base.py:15-22, used by QAdam's warmup boundary)."""
+        return False
+
+    def init_tensors(self, named_params: Sequence[NamedParam]) -> List[NamedParam]:
+        """Which tensors to communicate, in registration order (reference
+        base.py:24-49 registers grads in reversed module order — the caller
+        already passes reversed order)."""
+        return list(named_params)
+
+    def tensors_to_buckets(
+        self,
+        decl_buckets: Sequence[Sequence[TensorDeclaration]],
+        named_params: Sequence[NamedParam],
+        world_size: int,
+    ) -> BucketPlan:
+        """Declarations -> concrete plan (reference base.py:51-70)."""
+        return BucketPlan.from_declaration_buckets(
+            decl_buckets, named_params, alignment=self.bucket_alignment
+        )
+
+    # ---- traced stages --------------------------------------------------
+
+    def init_state(self, ctx: AlgorithmContext, params) -> Any:
+        """Create algorithm state (peer-weight replicas, momenta, ...)."""
+        return None
+
+    def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
+        """Gradient communication stage (runs where the reference's backward
+        hooks + wait_pending_comm_ops ran)."""
+        return grads, algo_state
+
+    def process_pre_step(self, ctx: AlgorithmContext, params, algo_state, step):
+        """Weight transformation after backward, before the optimizer update
+        (the reference's post-backward copy-back for decentralized ops)."""
+        return params, algo_state
+
+    def process_post_step(self, ctx: AlgorithmContext, params, algo_state, step):
+        """Weight transformation after the optimizer update (the reference's
+        post-optimizer-step hook, used by low-precision decentralized)."""
+        return params, algo_state
+
+    def optimizer_update(self, ctx, params, grads, opt_state, algo_state, step):
+        raise NotImplementedError("only algorithms with owns_optimizer=True")
+
+    def init_optimizer_state(self, params):
+        raise NotImplementedError("only algorithms with owns_optimizer=True")

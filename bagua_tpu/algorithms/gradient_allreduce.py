@@ -1,0 +1,32 @@
+"""Centralized synchronous full-precision data parallelism.
+
+Counterpart of /root/reference/bagua/torch_api/algorithms/gradient_allreduce.py:8-38
+plus its backing comm op
+(comm_ops/centralized_full_precision_synchronous.rs:16-56).  One fused
+``psum``/``pmean`` per bucket; XLA's latency-hiding scheduler overlaps the
+collectives with remaining backward compute, which is the whole job the
+reference's Rust scheduler + dedicated CUDA stream existed to do.
+"""
+
+from __future__ import annotations
+
+from ..communication import ReduceOp
+from .base import Algorithm, AlgorithmContext
+
+
+class GradientAllReduceAlgorithm(Algorithm):
+    def __init__(self, hierarchical: bool = False, average: bool = True):
+        """
+        Args:
+            hierarchical: Enable hierarchical (intra-node then inter-node)
+                communication.
+            average: If True average gradients over ranks, else sum.
+        """
+        self.hierarchical = hierarchical
+        self.average = average
+
+    def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
+        op = ReduceOp.AVG if self.average else ReduceOp.SUM
+        flats = ctx.plan.flatten_tree(grads)
+        flats = [ctx.hierarchical_allreduce(f, op, self.hierarchical) for f in flats]
+        return ctx.plan.unflatten_tree(flats, grads), algo_state

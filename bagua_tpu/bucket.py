@@ -1,0 +1,170 @@
+"""Bucketing: partition named tensors into flat, aligned communication buffers.
+
+Counterpart of the reference's ``BaguaBucket``
+(/root/reference/bagua/torch_api/bucket.py:15-123: in-place flattening into a
+contiguous buffer + padding tensor for alignment) and the autotuner's
+``split_bucket_by_bucket_size`` (service/autotune_task_manager.py:86-119).
+
+TPU-first rationale: the reference flattens so the Rust scheduler can issue one
+NCCL call per bucket.  Under XLA we flatten for the same reason — one large
+``psum``/``all_to_all`` per bucket beats many small ones on ICI — but the
+flattening is *traced* (concat inside the jitted step, fused by XLA) instead of
+aliasing storage.  Alignment padding to a multiple of the world size is what
+lets the compressed scatter-gather ops split a bucket into equal per-rank
+chunks (reference bytegrad.py:38-43).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .define import TensorDeclaration, TensorDtype, DTYPE_BYTES
+from .tensor import NamedParam, leaves_by_name
+from .utils import from_bagua_datatype
+
+
+def split_bucket_by_bucket_size(
+    tensor_list: List[TensorDeclaration],
+    bucket_size: int,
+    param_group_info: Optional[Dict[str, int]] = None,
+) -> List[List[TensorDeclaration]]:
+    """Greedy dtype-grouped split, mirroring the reference autotuner
+    (autotune_task_manager.py:86-119): iterate dtypes in sorted order, fill a
+    bucket until it reaches ``bucket_size`` bytes, then start a new one."""
+    param_group_info = param_group_info or {}
+    dtypes = sorted({TensorDtype(t.dtype).value for t in tensor_list})
+    buckets: List[List[TensorDeclaration]] = []
+    for dtype in dtypes:
+        # flush at dtype boundaries: a bucket is one flat buffer of one dtype
+        # (the reference's buckets are homogeneous in practice; carrying a
+        # partial bucket across dtypes would silently cast gradients)
+        tmp: List[TensorDeclaration] = []
+        tmp_bytes = 0
+        for td in [t for t in tensor_list if TensorDtype(t.dtype).value == dtype]:
+            tmp_bytes += td.nbytes
+            tmp.append(td)
+            if tmp_bytes >= bucket_size:
+                buckets.append(tmp)
+                tmp, tmp_bytes = [], 0
+        if tmp:
+            buckets.append(tmp)
+    for i in range(len(buckets)):
+        buckets[i] = sorted(buckets[i], key=lambda p: param_group_info.get(p.name, -1))
+    return buckets
+
+
+@dataclass(frozen=True)
+class BucketSpec:
+    """One bucket: ordered named tensors + alignment padding (reference
+    bucket.py:15-55)."""
+
+    name: str
+    tensors: Tuple[NamedParam, ...]
+    alignment: int = 1
+
+    @property
+    def numel(self) -> int:
+        return sum(t.numel for t in self.tensors)
+
+    @property
+    def padded_numel(self) -> int:
+        n = self.numel
+        if self.alignment > 1 and n % self.alignment:
+            n += self.alignment - n % self.alignment
+        return n
+
+    @property
+    def padding(self) -> int:
+        return self.padded_numel - self.numel
+
+    @property
+    def dtype(self):
+        return self.tensors[0].dtype
+
+    def offsets(self) -> List[int]:
+        offs, off = [], 0
+        for t in self.tensors:
+            offs.append(off)
+            off += t.numel
+        return offs
+
+    def signature(self) -> Tuple:
+        return (
+            self.name,
+            self.alignment,
+            tuple((t.name, t.shape, str(t.dtype)) for t in self.tensors),
+        )
+
+
+@dataclass(frozen=True)
+class BucketPlan:
+    """A full partition of the registered tensors into buckets."""
+
+    buckets: Tuple[BucketSpec, ...]
+
+    def signature(self) -> Tuple:
+        return tuple(b.signature() for b in self.buckets)
+
+    @property
+    def tensor_names(self) -> List[str]:
+        return [t.name for b in self.buckets for t in b.tensors]
+
+    @staticmethod
+    def from_declaration_buckets(
+        decl_buckets: Sequence[Sequence[TensorDeclaration]],
+        named_params: Sequence[NamedParam],
+        alignment: int = 1,
+    ) -> "BucketPlan":
+        by_name = {p.name: p for p in named_params}
+        specs = []
+        for i, db in enumerate(decl_buckets):
+            tensors = tuple(by_name[d.name] for d in db)
+            specs.append(BucketSpec(name=str(i), tensors=tensors, alignment=alignment))
+        plan = BucketPlan(buckets=tuple(specs))
+        missing = set(by_name) - set(plan.tensor_names)
+        if missing:
+            raise ValueError(f"bucket plan misses tensors: {sorted(missing)}")
+        return plan
+
+    @staticmethod
+    def build(
+        named_params: Sequence[NamedParam],
+        bucket_bytes: int,
+        alignment: int = 1,
+        param_group_info: Optional[Dict[str, int]] = None,
+    ) -> "BucketPlan":
+        decls = [p.declaration() for p in named_params]
+        decl_buckets = split_bucket_by_bucket_size(decls, bucket_bytes, param_group_info)
+        return BucketPlan.from_declaration_buckets(decl_buckets, named_params, alignment)
+
+    # ---- traced flatten/unflatten ------------------------------------
+
+    def flatten_tree(self, tree) -> List[jax.Array]:
+        """tree -> list of flat padded bucket buffers (traced; XLA fuses the
+        concatenation).  Equivalent of bucket.py:95-123 ``_flatten_``."""
+        named = leaves_by_name(tree)
+        flats = []
+        for b in self.buckets:
+            parts = [jnp.ravel(named[t.name]).astype(b.dtype) for t in b.tensors]
+            if b.padding:
+                parts.append(jnp.zeros((b.padding,), dtype=b.dtype))
+            flats.append(jnp.concatenate(parts) if len(parts) > 1 else parts[0])
+        return flats
+
+    def unflatten_to_named(self, flats: Sequence[jax.Array]) -> Dict[str, jax.Array]:
+        named = {}
+        for b, flat in zip(self.buckets, flats):
+            for t, off in zip(b.tensors, b.offsets()):
+                seg = jax.lax.slice_in_dim(flat, off, off + t.numel)
+                named[t.name] = seg.reshape(t.shape).astype(t.dtype)
+        return named
+
+    def unflatten_tree(self, flats: Sequence[jax.Array], tree_like):
+        from .tensor import tree_from_named
+
+        return tree_from_named(tree_like, self.unflatten_to_named(flats))

@@ -1,0 +1,1 @@
+from .backend import BaguaTrainer, TrainState  # noqa: F401

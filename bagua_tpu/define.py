@@ -1,0 +1,74 @@
+"""Shared typed definitions.
+
+Counterpart of the reference's ``bagua/bagua_define.py`` (TensorDeclaration :18,
+BaguaHyperparameter :34, BaguaCoreTelemetrySpan :53).  Same wire shape so the
+autotune HTTP protocol stays compatible.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List
+
+from pydantic import BaseModel
+
+
+class TensorDtype(str, enum.Enum):
+    F32 = "f32"
+    F16 = "f16"
+    BF16 = "bf16"
+    U8 = "u8"
+    I32 = "i32"
+    I64 = "i64"
+
+
+DTYPE_BYTES = {
+    TensorDtype.F32: 4,
+    TensorDtype.F16: 2,
+    TensorDtype.BF16: 2,
+    TensorDtype.U8: 1,
+    TensorDtype.I32: 4,
+    TensorDtype.I64: 8,
+}
+
+
+class TensorDeclaration(BaseModel):
+    name: str
+    num_elements: int
+    dtype: TensorDtype
+
+    def __hash__(self):  # used in ordering / dedup
+        return hash((self.name, self.num_elements, self.dtype))
+
+    @property
+    def nbytes(self) -> int:
+        return self.num_elements * DTYPE_BYTES[TensorDtype(self.dtype)]
+
+
+def get_tensor_declaration_bytes(td: TensorDeclaration) -> int:
+    return td.nbytes
+
+
+class BaguaHyperparameter(BaseModel):
+    """Tunable hyperparameters mutated by the autotune service
+    (reference bagua_define.py:34-50)."""
+
+    buckets: List[List[TensorDeclaration]] = []
+    is_hierarchical_reduce: bool = False
+    bucket_size: int = 10 * 1024 ** 2
+
+    def update(self, param_dict: dict) -> "BaguaHyperparameter":
+        tmp = self.dict()
+        tmp.update(param_dict)
+        for key, value in param_dict.items():
+            if key in tmp:
+                self.__dict__[key] = value
+        return self
+
+
+class BaguaCoreTelemetrySpan(BaseModel):
+    trace_id: int
+    action: str
+    tensor_name: str
+    start_time: int
+    end_time: int

@@ -1,0 +1,98 @@
+"""Environment / flag accessors.
+
+TPU-native counterpart of the reference's ``bagua/torch_api/env.py`` (see
+/root/reference/bagua/torch_api/env.py:1-101).  The reference reads
+``RANK``/``WORLD_SIZE``/``LOCAL_RANK``/... injected by its launcher; under JAX the
+process-level topology comes from :mod:`jax` itself (``jax.process_index`` /
+``jax.device_count``), while in-program data-parallel "ranks" are positions on a
+:class:`jax.sharding.Mesh` axis.  The ``BAGUA_*`` tunables keep their reference
+names so launcher scripts port over unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _int_env(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def get_rank() -> int:
+    """Global process rank (multi-host: one JAX process per host)."""
+    v = os.environ.get("RANK")
+    if v not in (None, ""):
+        return int(v)
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    """Number of processes in the job (reference env.py:24-31)."""
+    v = os.environ.get("WORLD_SIZE")
+    if v not in (None, ""):
+        return int(v)
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_local_rank() -> int:
+    return _int_env("LOCAL_RANK", 0)
+
+
+def get_local_size() -> int:
+    return _int_env("LOCAL_WORLD_SIZE", 1)
+
+
+def get_node_rank() -> int:
+    return _int_env("NODE_RANK", get_rank() // max(get_local_size(), 1))
+
+
+def get_master_addr() -> str:
+    return os.environ.get("MASTER_ADDR", "127.0.0.1")
+
+
+def get_default_bucket_size() -> int:
+    """Default bucket size in bytes; 10MB like the reference (env.py:50-57)."""
+    return _int_env("BAGUA_DEFAULT_BUCKET_SIZE", 10 * 1024 ** 2)
+
+
+def get_bagua_service_port() -> int:
+    return _int_env("BAGUA_SERVICE_PORT", -1)
+
+
+def get_autotune_level() -> int:
+    return _int_env("BAGUA_AUTOTUNE", 0)
+
+
+def get_autotune_max_samples() -> int:
+    return _int_env("BAGUA_AUTOTUNE_MAX_SAMPLES", 60)
+
+
+def get_autotune_sampling_confidence_time_s() -> float:
+    return float(os.environ.get("BAGUA_AUTOTUNE_SAMPLING_CONFIDENCE_TIME_S", 5.0))
+
+
+def get_autotune_warmup_time_s() -> float:
+    return float(os.environ.get("BAGUA_AUTOTUNE_WARMUP_TIME_S", 30.0))
+
+
+def is_report_metrics_switch_on() -> bool:
+    return _int_env("BAGUA_REPORT_METRICS", 0) == 1
+
+
+def is_output_autotune_log() -> bool:
+    return _int_env("BAGUA_IS_OUTPUT_AUTOTUNE_LOG", 0) == 1
+
+
+def get_autotune_server_addr() -> str | None:
+    return os.environ.get("AUTO_TUNE_SERVER_ADDR") or None

@@ -1,0 +1,22 @@
+"""Small MLP used by tests and the MNIST example (the reference's unit tests
+train tiny ``nn.Linear`` stacks, e.g. tests/torch_api/test_decentralized.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (64, 64, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x

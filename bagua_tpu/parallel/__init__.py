@@ -1,0 +1,7 @@
+from .mesh import (  # noqa: F401
+    build_mesh,
+    get_global_mesh,
+    hierarchical_mesh,
+    mesh_axis_size,
+    set_global_mesh,
+)

@@ -1,0 +1,110 @@
+"""Device-mesh construction — the TPU-native substrate for every communicator.
+
+The reference builds three NCCL communicators per model (global / intra-node /
+inter-node, /root/reference/bagua/torch_api/communication.py:47-72) and runs
+hierarchical collectives by hand (communicators/mod.rs:243-336).  On TPU the
+same roles are mesh axes: a 2-D ``('inter', 'intra')`` mesh makes XLA route the
+intra-node stage over ICI and the inter-node stage over DCN, so "hierarchical
+reduce" is just a nested collective over the two axes.
+
+Axis conventions used across bagua_tpu:
+
+- ``dp``     data parallel (the reference's only first-class dimension)
+- ``inter`` / ``intra``   hierarchical split of dp (node boundary)
+- ``ep``     expert parallel (MoE all-to-all axis)
+- ``sp``     sequence/context parallel (ring attention / Ulysses axis)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import env
+
+_GLOBAL_MESH: Optional[Mesh] = None
+
+
+def build_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Create a named mesh over ``devices`` (default: all devices).
+
+    ``axis_sizes`` maps axis name -> size; a single ``-1`` entry is inferred.
+    Default is a 1-D data-parallel mesh ``{'dp': n_devices}``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if not axis_sizes:
+        axis_sizes = {"dp": n}
+    axis_sizes = dict(axis_sizes)
+
+    unknown = [k for k, v in axis_sizes.items() if v == -1]
+    known = int(np.prod([v for v in axis_sizes.values() if v != -1])) if axis_sizes else 1
+    if len(unknown) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if unknown:
+        if n % known != 0:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        axis_sizes[unknown[0]] = n // known
+    total = int(np.prod(list(axis_sizes.values())))
+    if total != n:
+        raise ValueError(f"mesh {axis_sizes} needs {total} devices, have {n}")
+
+    shape = tuple(axis_sizes.values())
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_sizes.keys()))
+
+
+def hierarchical_mesh(
+    intra_size: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """2-D ``('inter', 'intra')`` mesh; ``intra`` is the node-local axis.
+
+    Mirrors the reference's inter/intra communicator split
+    (communication.py:156-227).  ``intra_size`` defaults to the local device
+    count (devices per host), the direct analog of ``nranks_per_node``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if intra_size is None:
+        intra_size = min(jax.local_device_count(), n)
+        while n % intra_size != 0:
+            intra_size //= 2
+        intra_size = max(intra_size, 1)
+    if n % intra_size != 0:
+        raise ValueError(f"{n} devices not divisible by intra_size={intra_size}")
+    return build_mesh({"inter": n // intra_size, "intra": intra_size}, devices)
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_global_mesh() -> Mesh:
+    """The process-wide default mesh (created on first use: 1-D dp mesh)."""
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = build_mesh()
+    return _GLOBAL_MESH
+
+
+def get_global_mesh_if_set() -> Optional[Mesh]:
+    """The explicitly registered mesh (via init_process_group/set_global_mesh),
+    or None — never creates a default."""
+    return _GLOBAL_MESH
+
+
+def mesh_axis_size(mesh: Mesh, axes) -> int:
+    if isinstance(axes, str):
+        axes = (axes,)
+    return int(math.prod(mesh.shape[a] for a in axes))

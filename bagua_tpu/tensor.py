@@ -1,0 +1,94 @@
+"""Named-parameter registry: pytree leaves <-> stable tensor names.
+
+Counterpart of the reference's ``BaguaTensor`` patching + ``bagua_build_params``
+(/root/reference/bagua/torch_api/tensor.py:24-80,
+/root/reference/bagua/torch_api/distributed.py:49-100).  The reference wraps
+live ``torch.Tensor`` storage; in JAX a "tensor" is a pytree leaf, so the
+registry records (name, path, shape, dtype) and the bucket layer works on
+flattened segments.  ``bagua_mark_communication_ready`` has no analog: under
+XLA the collective schedule is fixed at compile time and overlap is done by
+the latency-hiding scheduler, not by readiness events.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .define import TensorDeclaration
+from .utils import to_bagua_datatype
+
+
+@dataclass(frozen=True)
+class NamedParam:
+    """One registered tensor: a named view onto a pytree leaf."""
+
+    name: str
+    path: Tuple  # jax key path into the tree
+    shape: Tuple[int, ...]
+    dtype: Any
+
+    @property
+    def numel(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def declaration(self) -> TensorDeclaration:
+        return TensorDeclaration(
+            name=self.name, num_elements=self.numel, dtype=to_bagua_datatype(self.dtype)
+        )
+
+
+def _name_of_path(path) -> str:
+    s = jax.tree_util.keystr(path)
+    s = re.sub(r"[\[\]'\.]+", ".", s).strip(".")
+    return s
+
+
+def build_params(tree, reverse: bool = True) -> List[NamedParam]:
+    """Collect named params in (by default) reversed traversal order.
+
+    The reference registers gradients in reversed module order because that is
+    roughly backward-execution order (distributed.py:93-100, base.py:37-49);
+    we keep the same order so bucket contents line up with the reference's.
+    """
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = [
+        NamedParam(
+            name=_name_of_path(path),
+            path=path,
+            shape=tuple(leaf.shape),
+            dtype=jnp.asarray(leaf).dtype if not hasattr(leaf, "dtype") else leaf.dtype,
+        )
+        for path, leaf in leaves
+    ]
+    if reverse:
+        out = list(reversed(out))
+    # duplicate detection (reference lib.rs:280-295)
+    names = [p.name for p in out]
+    if len(set(names)) != len(names):
+        dup = [n for n in names if names.count(n) > 1]
+        raise ValueError(f"duplicate tensor names in model: {sorted(set(dup))}")
+    return out
+
+
+def leaves_by_name(tree) -> Dict[str, jax.Array]:
+    return {
+        _name_of_path(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+
+def tree_from_named(tree_like, named: Dict[str, jax.Array]):
+    """Rebuild a tree shaped like ``tree_like`` taking leaves from ``named``
+    (by name) when present."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(tree_like)
+    leaves = []
+    for path, leaf in paths:
+        name = _name_of_path(path)
+        leaves.append(named.get(name, leaf))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,190 @@
+"""Small utilities: pytree flatten helpers, dtype mapping, rate tracking.
+
+Counterpart of reference ``bagua/torch_api/utils.py`` (flatten/unflatten :10-54,
+to_bagua_datatype :205, StatisticalAverage :251-368).  Flattening here operates
+on JAX pytrees instead of torch tensor lists; the fused-param-storage helpers
+(`flatten_module_params`) have no TPU analog because XLA owns layout — the
+bucket layer (bagua_tpu/bucket.py) is the equivalent mechanism.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .define import TensorDtype
+
+
+def to_bagua_datatype(dtype) -> TensorDtype:
+    """jnp/np dtype -> wire datatype name (reference utils.py:205-216)."""
+    d = jnp.dtype(dtype)
+    if d == jnp.float32:
+        return TensorDtype.F32
+    if d == jnp.float16:
+        return TensorDtype.F16
+    if d == jnp.bfloat16:
+        return TensorDtype.BF16
+    if d == jnp.uint8:
+        return TensorDtype.U8
+    if d == jnp.int32:
+        return TensorDtype.I32
+    if d == jnp.int64:
+        return TensorDtype.I64
+    raise ValueError(f"unsupported data type {dtype}.")
+
+
+def from_bagua_datatype(dtype: TensorDtype):
+    return {
+        TensorDtype.F32: jnp.float32,
+        TensorDtype.F16: jnp.float16,
+        TensorDtype.BF16: jnp.bfloat16,
+        TensorDtype.U8: jnp.uint8,
+        TensorDtype.I32: jnp.int32,
+        TensorDtype.I64: jnp.int64,
+    }[TensorDtype(dtype)]
+
+
+def flatten(arrays: List[jax.Array]) -> jax.Array:
+    """Concatenate arrays into one flat 1-D buffer (reference utils.py:10-25)."""
+    if len(arrays) == 0:
+        return jnp.zeros((0,), dtype=jnp.float32)
+    return jnp.concatenate([jnp.ravel(a) for a in arrays])
+
+
+def unflatten(flat: jax.Array, like: List[jax.Array]) -> List[jax.Array]:
+    """Split a flat buffer back into arrays shaped like ``like``
+    (reference utils.py:28-43)."""
+    outs = []
+    offset = 0
+    for a in like:
+        n = a.size
+        outs.append(jax.lax.dynamic_slice_in_dim(flat, offset, n).reshape(a.shape))
+        offset += n
+    return outs
+
+
+def check_contiguous(sizes: List[int], offsets: List[int]) -> bool:
+    off = 0
+    for s, o in zip(sizes, offsets):
+        if o != off:
+            return False
+        off += s
+    return True
+
+
+def apply_flattened_call(tree, call):
+    leaves, treedef = jax.tree.flatten(tree)
+    flat = flatten(leaves)
+    flat = call(flat)
+    return jax.tree.unflatten(treedef, unflatten(flat, leaves))
+
+
+def average_by_removing_extreme_values(raw_score_list):
+    """Robust mean: drop values > 3 sigma from the median-ish mean, like the
+    reference's speed averaging (utils.py:219-248)."""
+    score_list = np.asarray(raw_score_list, dtype=np.float64)
+    while len(score_list) > 2:
+        mean = score_list.mean()
+        std = score_list.std()
+        keep = np.abs(score_list - mean) <= 3 * std
+        if keep.all():
+            break
+        score_list = score_list[keep]
+    return float(score_list.mean()), float(score_list.std()), score_list.tolist()
+
+
+class StatisticalAverage:
+    """Exponentially time-bucketed rate tracker (reference utils.py:251-368).
+
+    Records a cumulative value (e.g. samples processed) at wall-clock times and
+    answers "average rate over the last T seconds" with power-of-two bucketing.
+    """
+
+    def __init__(self, last_update_time: float = None, records: List[float] = None,
+                 record_tail: Tuple[float, float] = (0.0, 0.0)):
+        self.last_update_time = time.time() if last_update_time is None else last_update_time
+        self.records: List[float] = list(records) if records else []
+        self.record_tail = record_tail
+
+    def record_seconds(self) -> float:
+        return 2.0 ** len(self.records) if self.records else 0.0
+
+    def total_recording_time(self) -> float:
+        tail_sec, _ = self.record_tail
+        return self.record_seconds() + tail_sec
+
+    def get_records_mean(self, last_n_seconds: float) -> float:
+        if last_n_seconds <= 0:
+            return 0.0
+        records_seconds = self.record_seconds()
+        tail_seconds, tail_mean = self.record_tail
+        if len(self.records) == 0:
+            return tail_mean
+        if last_n_seconds < 1.0:
+            return self.records[0]
+        if last_n_seconds <= records_seconds:
+            mean = 0.0
+            cnt = int(math.floor(math.log2(last_n_seconds)))
+            for i in range(cnt):
+                mean += (2.0 ** i / last_n_seconds) * self.records[i]
+            last_sec = last_n_seconds - 2.0 ** cnt + (2.0 ** cnt - sum(2.0 ** i for i in range(cnt)))
+            mean += max(last_sec, 0.0) / last_n_seconds * self.records[min(cnt, len(self.records) - 1)]
+            return mean
+        mean = (records_seconds / max(last_n_seconds, 1e-9)) * (
+            sum(2.0 ** i * r for i, r in enumerate(self.records)) / max(records_seconds, 1e-9)
+        )
+        remain = min(last_n_seconds - records_seconds, tail_seconds)
+        mean += (remain / max(last_n_seconds, 1e-9)) * tail_mean
+        return mean
+
+    def record(self, val: float):
+        now = time.time()
+        elapsed = now - self.last_update_time
+        new_records: List[float] = []
+        total = self.total_recording_time()
+        i = 0
+        while 2.0 ** i <= total + elapsed:
+            seconds = 2.0 ** i
+            if seconds <= elapsed:
+                new_records.append(val)
+            else:
+                mean = (elapsed / seconds) * val + ((seconds - elapsed) / seconds) * self.get_records_mean(seconds - elapsed)
+                new_records.append(mean)
+            i += 1
+        tail_total = min(total + elapsed, 2.0 ** 10)
+        tail_sec = max(tail_total - (2.0 ** (len(new_records)) - 1 if new_records else 0), 0.0)
+        tail_mean = self.get_records_mean(tail_total) if tail_sec > 0 else 0.0
+        self.records = new_records
+        self.record_tail = (tail_sec, tail_mean)
+        self.last_update_time = now
+
+    def get(self, last_n_seconds: float) -> float:
+        elapsed = time.time() - self.last_update_time
+        if elapsed >= last_n_seconds:
+            return 0.0
+        return self.get_records_mean(last_n_seconds - elapsed) * (
+            (last_n_seconds - elapsed) / last_n_seconds
+        )
+
+    def total(self) -> float:
+        total_sec = self.total_recording_time()
+        return self.get_records_mean(total_sec) * total_sec
+
+
+def find_free_port(low: int = 20000, high: int = 65000) -> int:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+logger = logging.getLogger("bagua_tpu")

@@ -1,0 +1,58 @@
+"""Bucketization unit tests (reference: bucket flattening bucket.py:95-123 and
+autotune split autotune_task_manager.py:86-119)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bagua_tpu import BucketPlan, TensorDtype, build_params, split_bucket_by_bucket_size
+from bagua_tpu.define import TensorDeclaration
+
+
+def _params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "a": jax.random.normal(k, (4, 5)),
+        "b": jax.random.normal(k, (7,)),
+        "c": jax.random.normal(k, (3, 3)),
+    }
+
+
+def test_build_params_reversed_dedup():
+    named = build_params(_params())
+    assert [p.name for p in named] == ["c", "b", "a"]
+    assert named[0].numel == 9
+
+
+def test_split_by_bucket_size():
+    decls = [
+        TensorDeclaration(name=f"t{i}", num_elements=100, dtype=TensorDtype.F32)
+        for i in range(10)
+    ]
+    buckets = split_bucket_by_bucket_size(decls, 400)  # 400 bytes = 1 tensor each
+    assert all(len(b) == 1 for b in buckets)
+    buckets = split_bucket_by_bucket_size(decls, 800)
+    assert len(buckets) == 5
+    # everything lands somewhere exactly once
+    names = [t.name for b in buckets for t in b]
+    assert sorted(names) == sorted(d.name for d in decls)
+
+
+def test_plan_flatten_roundtrip():
+    params = _params()
+    named = build_params(params)
+    plan = BucketPlan.build(named, bucket_bytes=64, alignment=8)
+    flats = plan.flatten_tree(params)
+    assert all(f.shape[0] % 8 == 0 for f in flats)
+    back = plan.unflatten_tree(flats, params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(back[k]), np.asarray(params[k]), rtol=1e-6)
+
+
+def test_plan_signature_changes_with_bucketing():
+    params = _params()
+    named = build_params(params)
+    p1 = BucketPlan.build(named, bucket_bytes=64)
+    p2 = BucketPlan.build(named, bucket_bytes=10 ** 9)
+    assert p1.signature() != p2.signature()
+    assert len(p2.buckets) == 1

@@ -1,0 +1,110 @@
+"""Collective-primitive correctness vs numpy reference.
+
+Mirrors the reference's communicator tests
+(/root/reference/tests/comm/test_communicator.py:40-162 — allreduce/p2p/
+allgather against torch.distributed) and the cross-check example
+(/root/reference/examples/communication_primitives/main.py:25-71).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bagua_tpu
+from bagua_tpu import ReduceOp
+from bagua_tpu.communication import BaguaCommunicator, get_backend
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0)
+
+
+def _rank_data(rng, shape=(N, 16)):
+    return jnp.asarray(rng.normal(size=shape).astype(np.float32))
+
+
+def test_allreduce_avg(rng):
+    x = _rank_data(rng)
+    out = bagua_tpu.allreduce(x, op=ReduceOp.AVG)
+    expect = np.broadcast_to(np.asarray(x).mean(axis=0, keepdims=True), x.shape)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+
+def test_allreduce_sum_max_min(rng):
+    x = _rank_data(rng)
+    for op, red in [(ReduceOp.SUM, np.sum), (ReduceOp.MAX, np.max), (ReduceOp.MIN, np.min)]:
+        out = bagua_tpu.allreduce(x, op=op)
+        expect = np.broadcast_to(red(np.asarray(x), axis=0, keepdims=True), x.shape)
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+
+def test_allreduce_product(rng):
+    x = jnp.asarray(rng.uniform(0.5, 1.5, size=(N, 8)).astype(np.float32))
+    out = bagua_tpu.allreduce(x, op=ReduceOp.PRODUCT)
+    expect = np.broadcast_to(np.prod(np.asarray(x), axis=0, keepdims=True), x.shape)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+
+def test_allgather(rng):
+    x = _rank_data(rng, (N, 4))
+    out = bagua_tpu.allgather(x)
+    # every rank slice holds the concatenation of all rank slices
+    expect = np.broadcast_to(np.asarray(x).reshape(1, -1), (N, N * 4)).reshape(N, N * 4)
+    np.testing.assert_allclose(np.asarray(out).reshape(N, -1)[0], expect[0], rtol=1e-6)
+    assert out.shape == (N * N * 4 // N, 4) or out.size == N * N * 4
+
+
+def test_reduce_scatter(rng):
+    x = _rank_data(rng, (N, N * 3))
+    out = bagua_tpu.reduce_scatter(x, op=ReduceOp.SUM)
+    xs = np.asarray(x)
+    total = xs.sum(axis=0).reshape(N, 3)
+    np.testing.assert_allclose(np.asarray(out).reshape(N, 3), total, rtol=1e-5)
+
+
+def test_alltoall(rng):
+    x = _rank_data(rng, (N, N * 2))
+    out = bagua_tpu.alltoall(x)
+    xs = np.asarray(x).reshape(N, N, 2)
+    expect = np.transpose(xs, (1, 0, 2)).reshape(N, N * 2)
+    np.testing.assert_allclose(np.asarray(out).reshape(N, N * 2), expect, rtol=1e-6)
+
+
+def test_broadcast(rng):
+    x = _rank_data(rng, (N, 5))
+    out = bagua_tpu.broadcast(x, src=3)
+    expect = np.broadcast_to(np.asarray(x)[3:4], (N, 5))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_reduce_to_dst(rng):
+    x = _rank_data(rng, (N, 5))
+    out = bagua_tpu.reduce(x, dst=2, op=ReduceOp.SUM)
+    xs = np.asarray(x)
+    np.testing.assert_allclose(np.asarray(out)[2], xs.sum(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out)[0], xs[0], rtol=1e-6)
+
+
+def test_send_recv_ring(rng):
+    x = _rank_data(rng, (N, 3))
+    perm = [(r, (r + 1) % N) for r in range(N)]
+    out = bagua_tpu.send_recv(x, perm)
+    xs = np.asarray(x)
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(out)[r], xs[(r - 1) % N], rtol=1e-6)
+
+
+def test_barrier():
+    bagua_tpu.barrier()
+
+
+def test_hierarchical_backend_axes():
+    be = get_backend("test_model")
+    assert be.global_communicator.nranks() == N
+    assert (
+        be.intranode_communicator.nranks() * be.internode_communicator.nranks() == N
+    )
