@@ -1,0 +1,167 @@
+"""Asynchronous model averaging.
+
+Counterpart of /root/reference/bagua/torch_api/algorithms/async_model_average.py
+(:156-233) + comm_ops/decentralized_full_precision_asynchronous.rs: a
+background loop continuously allreduce-averages the weights while compute
+proceeds, with a lock so weights are swapped only between steps, and
+``abort``/``resume`` control.
+
+TPU-native mechanism: the reference needs a worker thread + CUDA stream +
+weight mutex because torch executes eagerly.  JAX's async dispatch already
+gives us a "background stream": the averaging is its own tiny jitted
+collective, dispatched without blocking the Python loop; train steps keep
+executing on stale local weights while it's in flight (same staleness
+semantics as the reference), and the result is swapped into the train state
+between steps — the functional equivalent of the reference's weight lock held
+during forward/backward (:156-168).  ``warmup_steps`` of synchronous gradient
+allreduce match the reference (:60, :125-131).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..communication import ReduceOp
+from .base import Algorithm, AlgorithmContext
+
+logger = logging.getLogger(__name__)
+
+_RUNNING = "running"
+_ABORTED = "aborted"
+
+
+class AsyncModelAverageAlgorithm(Algorithm):
+    replicated_params = False
+
+    def __init__(
+        self,
+        peer_selection_mode: str = "all",
+        sync_interval_ms: int = 500,
+        warmup_steps: int = 0,
+    ):
+        """
+        Args:
+            peer_selection_mode: Only ``"all"`` is supported (as in the
+                reference async op).
+            sync_interval_ms: Minimum milliseconds between launching two
+                averaging rounds (reference sync_interval_ms).
+            warmup_steps: Initial steps of synchronous gradient allreduce
+                before going asynchronous (reference :60).
+        """
+        assert peer_selection_mode == "all"
+        self.peer_selection_mode = peer_selection_mode
+        self.sync_interval_ms = sync_interval_ms
+        self.warmup_steps = warmup_steps
+        self._status = _RUNNING
+        self._pending: Optional[Any] = None
+        self._avg_fn = None
+        self._last_launch = 0.0
+        self._lock = threading.Lock()
+
+    # ---- traced stages ---------------------------------------------------
+
+    def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
+        # warmup: plain synchronous allreduce of gradients (reference
+        # :125-131 registers a centralized op during warmup)
+        if self.warmup_steps > 0:
+            flats = ctx.plan.flatten_tree(grads)
+
+            def sync(fs):
+                return [ctx.comm.allreduce(f, ReduceOp.AVG) for f in fs]
+
+            flats = jax.lax.cond(step < self.warmup_steps, sync, lambda fs: fs, flats)
+            grads = ctx.plan.unflatten_tree(flats, grads)
+        return grads, algo_state
+
+    # ---- host-side async loop -------------------------------------------
+
+    def _ensure_avg_fn(self, trainer):
+        if self._avg_fn is not None:
+            return
+        mesh = trainer.mesh
+        comm = trainer._comm
+        spec = P(comm.axis_name if len(comm.axes) == 1 else comm.axes)
+
+        def avg(params_stacked):
+            p = jax.tree.map(lambda x: x[0], params_stacked)
+            p = jax.tree.map(lambda x: comm.allreduce(x, ReduceOp.AVG), p)
+            return jax.tree.map(lambda x: x[None], p)
+
+        self._avg_fn = jax.jit(
+            jax.shard_map(avg, mesh=mesh, in_specs=spec, out_specs=spec,
+                          check_vma=False)
+        )
+        # apply the averaging as a DELTA onto the current weights, exactly the
+        # reference kernel's `x += reduced/n - copy` under the weight lock
+        # (decentralized_full_precision_asynchronous.rs:121-126): local
+        # progress made while the collective was in flight is preserved.
+        self._combine_fn = jax.jit(
+            lambda cur, avg_, snap: jax.tree.map(
+                lambda c, a, s: c + a - s, cur, avg_, snap
+            )
+        )
+        self._snap_fn = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+
+    def host_pre_step(self, trainer, state):
+        """Between-steps swap point (the reference's weight lock boundary)."""
+        import time
+
+        if self._status != _RUNNING or trainer._step_counter <= self.warmup_steps:
+            return state
+        self._ensure_avg_fn(trainer)
+        with self._lock:
+            if self._pending is not None:
+                avg_result, snapshot = self._pending
+                if all(l.is_ready() for l in jax.tree.leaves(avg_result)):
+                    state = state._replace(
+                        params=self._combine_fn(state.params, avg_result, snapshot)
+                    )
+                    self._pending = None
+            now = time.monotonic()
+            if (
+                self._pending is None
+                and (now - self._last_launch) * 1000.0 >= self.sync_interval_ms
+            ):
+                # snapshot = explicit copy (the reference op copies weights on
+                # the torch stream first, rs:50-60): the train step donates
+                # state.params, so the retained snapshot needs its own buffers
+                snapshot = self._snap_fn(state.params)
+                # dispatch is async: train steps keep running while the
+                # averaging collective is in flight
+                self._pending = (self._avg_fn(snapshot), snapshot)
+                self._last_launch = now
+        return state
+
+    # ---- control (reference :203-233) -----------------------------------
+
+    def abort(self):
+        """Stop background averaging (e.g. before evaluation)."""
+        with self._lock:
+            self._status = _ABORTED
+            self._pending = None
+        logger.info("async model average aborted")
+
+    def resume(self):
+        """Resume background averaging."""
+        with self._lock:
+            self._status = _RUNNING
+        logger.info("async model average resumed")
+
+    def barrier(self, trainer, state):
+        """Drain any in-flight averaging and apply it (the reference's
+        post-abort synchronization)."""
+        with self._lock:
+            if self._pending is not None:
+                avg_result, snapshot = self._pending
+                jax.block_until_ready(avg_result)
+                state = state._replace(
+                    params=self._combine_fn(state.params, avg_result, snapshot)
+                )
+                self._pending = None
+        return state

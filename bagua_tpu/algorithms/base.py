@@ -125,3 +125,12 @@ class Algorithm:
 
     def init_optimizer_state(self, params):
         raise NotImplementedError("only algorithms with owns_optimizer=True")
+
+    # ---- host-side hook --------------------------------------------------
+
+    def host_pre_step(self, trainer, state):
+        """Host-side (untraced) hook run at the top of every
+        ``BaguaTrainer.train_step`` — the between-steps boundary where
+        asynchronous algorithms swap weights (reference async
+        init_forward_pre_hook's lock, async_model_average.py:156-168)."""
+        return state

@@ -1,0 +1,63 @@
+"""ByteGrad: 8-bit compressed gradient allreduce.
+
+Counterpart of /root/reference/bagua/torch_api/algorithms/bytegrad.py (buckets
+aligned to the world size :38-43, centralized op with
+``scattergather=True, compression="MinMaxUInt8"`` :50-56) backed by
+comm_ops/centralized_low_precision_synchronous.rs.
+
+Hierarchical mode follows the reference's Leader pattern
+(communicators/mod.rs:264-297): average full-precision inside the node (ICI is
+cheap), then run the compressed scatter-gather across nodes.
+"""
+
+from __future__ import annotations
+
+from ..communication import ReduceOp
+from ..compression import compressed_scatter_gather_allreduce
+from .base import Algorithm, AlgorithmContext
+
+
+class ByteGradAlgorithm(Algorithm):
+    def __init__(self, hierarchical: bool = True, average: bool = True):
+        """
+        Args:
+            hierarchical: Enable hierarchical communication (intra-node
+                full-precision average, inter-node compressed).
+            average: If True average the reduced gradients, else sum.
+        """
+        self.hierarchical = hierarchical
+        self.average = average
+
+    def tensors_to_buckets(self, decl_buckets, named_params, world_size):
+        from ..bucket import BucketPlan
+
+        # align bucket length to the world size so each rank owns an equal
+        # chunk in the scatter-gather (reference bytegrad.py:38-43)
+        return BucketPlan.from_declaration_buckets(
+            decl_buckets, named_params, alignment=world_size
+        )
+
+    def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
+        flats = ctx.plan.flatten_tree(grads)
+        use_hier = (
+            self.hierarchical
+            and ctx.internode is not None
+            and ctx.intranode is not None
+            and ctx.internode.nranks() > 1
+            and ctx.intranode.nranks() > 1
+        )
+        out = []
+        for f in flats:
+            if use_hier:
+                f = ctx.intranode.allreduce(
+                    f, ReduceOp.AVG if self.average else ReduceOp.SUM
+                )
+                f = compressed_scatter_gather_allreduce(
+                    ctx.internode, f, average=self.average
+                )
+            else:
+                comm = ctx.comm
+                if comm.nranks() > 1:
+                    f = compressed_scatter_gather_allreduce(comm, f, average=self.average)
+            out.append(f)
+        return ctx.plan.unflatten_tree(out, grads), algo_state

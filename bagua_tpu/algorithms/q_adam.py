@@ -1,0 +1,145 @@
+"""QAdam: quantized-momentum Adam (1-bit-Adam family).
+
+Counterpart of /root/reference/bagua/torch_api/algorithms/q_adam.py:13-203.
+Two phases, switched by ``need_reset`` at the warmup boundary (:118-125):
+
+- warmup (``step < warmup_steps``): gradients are full-precision averaged,
+  both Adam moments update from the averaged gradient (:88-92), parameters
+  step by the Adam rule (:94-100).
+- compressed: the *momentum* (``exp_avg``) updates locally from the raw
+  gradient (the reference's in-pipeline python op :178-189), is then
+  8-bit-compressed scatter-gather averaged (:190-195), and the second moment
+  is frozen (:88 guard).
+
+The algorithm owns its optimizer (the reference requires the dedicated
+``QAdamOptimizer``), so the trainer's optax path is bypassed.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..communication import ReduceOp
+from ..compression import compressed_scatter_gather_allreduce
+from .base import Algorithm, AlgorithmContext
+
+
+class QAdamOptState(NamedTuple):
+    exp_avg: object
+    exp_avg_sq: object
+
+
+class QAdamAlgorithm(Algorithm):
+    owns_optimizer = True
+
+    def __init__(
+        self,
+        warmup_steps: int = 100,
+        lr: float = 1e-3,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        hierarchical: bool = True,
+    ):
+        """
+        Args:
+            warmup_steps: Steps of full-precision gradient allreduce before
+                switching to compressed momentum communication.
+            lr / betas / eps / weight_decay: Adam hyperparameters (reference
+                QAdamOptimizer q_adam.py:13-46).
+            hierarchical: Enable hierarchical communication in the
+                compressed phase.
+        """
+        self.warmup_steps = warmup_steps
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.hierarchical = hierarchical
+        self._compressed = False
+
+    def need_reset(self, step: int) -> bool:
+        if step == self.warmup_steps and not self._compressed:
+            self._compressed = True
+            return True
+        return False
+
+    def tensors_to_buckets(self, decl_buckets, named_params, world_size):
+        from ..bucket import BucketPlan
+
+        # world-size alignment for the compressed scatter-gather
+        # (reference q_adam.py:158-166 aligns buckets to get_world_size())
+        return BucketPlan.from_declaration_buckets(
+            decl_buckets, named_params, alignment=world_size
+        )
+
+    # ---- phase 1: warmup grad allreduce ---------------------------------
+
+    def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
+        if self._compressed:
+            return grads, algo_state
+        flats = ctx.plan.flatten_tree(grads)
+        flats = [ctx.hierarchical_allreduce(f, ReduceOp.AVG, False) for f in flats]
+        return ctx.plan.unflatten_tree(flats, grads), algo_state
+
+    # ---- optimizer -------------------------------------------------------
+
+    def init_optimizer_state(self, params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return QAdamOptState(exp_avg=zeros, exp_avg_sq=jax.tree.map(jnp.zeros_like, params))
+
+    def _communicate_momentum(self, ctx: AlgorithmContext, exp_avg):
+        flats = ctx.plan.flatten_tree(exp_avg)
+        use_hier = (
+            self.hierarchical
+            and ctx.internode is not None
+            and ctx.intranode is not None
+            and ctx.internode.nranks() > 1
+            and ctx.intranode.nranks() > 1
+        )
+        out = []
+        for f in flats:
+            if use_hier:
+                f = ctx.intranode.allreduce(f, ReduceOp.AVG)
+                f = compressed_scatter_gather_allreduce(ctx.internode, f, average=True)
+            elif ctx.comm.nranks() > 1:
+                f = compressed_scatter_gather_allreduce(ctx.comm, f, average=True)
+            out.append(f)
+        return ctx.plan.unflatten_tree(out, exp_avg)
+
+    def optimizer_update(self, ctx, params, grads, opt_state: QAdamOptState, algo_state, step):
+        beta1, beta2 = self.betas
+        # reference QAdamOptimizer.step increments step_id first (:77), so the
+        # bias corrections use step_id = step + 1
+        step_id = (step + 1).astype(jnp.float32)
+
+        exp_avg = jax.tree.map(
+            lambda m, g: m * beta1 + g * (1.0 - beta1), opt_state.exp_avg, grads
+        )
+        if self._compressed:
+            # second moment frozen (q_adam.py:88 guard); momentum averaged
+            # via the compressed pipeline
+            exp_avg = self._communicate_momentum(ctx, exp_avg)
+            exp_avg_sq = opt_state.exp_avg_sq
+        else:
+            exp_avg_sq = jax.tree.map(
+                lambda v, g: v * beta2 + (g * g) * (1.0 - beta2),
+                opt_state.exp_avg_sq,
+                grads,
+            )
+
+        bias1 = 1.0 - beta1 ** step_id
+        bias2 = 1.0 - beta2 ** step_id
+
+        def upd(p, m, v):
+            denom = jnp.sqrt(v) / jnp.sqrt(bias2) + self.eps
+            new_p = p - (self.lr / bias1) * (m / denom)
+            if self.weight_decay:
+                new_p = new_p - self.lr * self.weight_decay * p
+            return new_p
+
+        new_params = jax.tree.map(upd, params, exp_avg, exp_avg_sq)
+        return new_params, QAdamOptState(exp_avg, exp_avg_sq), algo_state

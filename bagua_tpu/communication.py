@@ -57,6 +57,14 @@ def _tree_map(f, tree):
     return jax.tree.map(f, tree)
 
 
+def collapse_trivial_axes(mesh: Mesh, axes) -> Tuple[str, ...]:
+    """Drop size-1 axes (keeping at least one) so single-axis collectives
+    (alltoall/ppermute) work whenever the topology is effectively 1-D."""
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    nontrivial = tuple(a for a in axes if mesh.shape[a] > 1)
+    return nontrivial if nontrivial else axes[-1:]
+
+
 class BaguaCommunicator:
     """A communicator spanning one or more mesh axes.
 
@@ -193,14 +201,7 @@ class BaguaBackend:
         self.mesh = mesh
         names = mesh.axis_names
         if "inter" in names and "intra" in names:
-            # collapse trivial axes so single-axis ops (alltoall/ppermute)
-            # work on the global communicator whenever possible
-            if mesh.shape["inter"] == 1:
-                glob: Tuple[str, ...] = ("intra",)
-            elif mesh.shape["intra"] == 1:
-                glob = ("inter",)
-            else:
-                glob = ("inter", "intra")
+            glob = collapse_trivial_axes(mesh, ("inter", "intra"))
             self.global_communicator = BaguaCommunicator(glob, mesh)
             self.internode_communicator = BaguaCommunicator("inter", mesh)
             self.intranode_communicator = BaguaCommunicator("intra", mesh)

@@ -1,0 +1,5 @@
+from .minmax_uint8 import (  # noqa: F401
+    compress_chunked,
+    compressed_scatter_gather_allreduce,
+    decompress_chunked,
+)

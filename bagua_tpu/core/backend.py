@@ -31,7 +31,7 @@ from jax import shard_map
 from .. import env
 from ..algorithms.base import Algorithm, AlgorithmContext
 from ..bucket import BucketPlan, split_bucket_by_bucket_size
-from ..communication import BaguaCommunicator, ReduceOp
+from ..communication import BaguaCommunicator, ReduceOp, collapse_trivial_axes
 from ..parallel.mesh import build_mesh, hierarchical_mesh, mesh_axis_size
 from ..tensor import build_params
 from ..utils import StatisticalAverage
@@ -98,7 +98,7 @@ class BaguaTrainer:
         self.model_name = model_name
         self.donate = donate
 
-        comm = BaguaCommunicator(self.dp_axes, mesh)
+        comm = BaguaCommunicator(collapse_trivial_axes(mesh, self.dp_axes), mesh)
         inter = BaguaCommunicator("inter", mesh) if "inter" in mesh.axis_names else None
         intra = BaguaCommunicator("intra", mesh) if "intra" in mesh.axis_names else None
         self._comm, self._inter, self._intra = comm, inter, intra
@@ -246,6 +246,7 @@ class BaguaTrainer:
 
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         self._step_counter += 1
+        state = self.algorithm.host_pre_step(self, state)
         if self.algorithm.need_reset(self._step_counter - 1):
             self._phase += 1
             # reference re-runs init_tensors + rebucketing at phase switches

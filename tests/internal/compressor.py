@@ -1,0 +1,28 @@
+"""Pure-numpy MinMaxUInt8 golden model.
+
+Same role as the reference's pure-torch golden
+(/root/reference/tests/internal/compressor.py:4-33): an independent
+reimplementation of the quantization math used to validate the production
+codec."""
+
+import numpy as np
+
+
+class MinMaxUInt8Numpy:
+    eps = 1e-7
+    levels = 255.0
+
+    def compress(self, x: np.ndarray):
+        _min, _max = float(x.min()), float(x.max())
+        scale = self.levels / (_max - _min + self.eps)
+        upper = np.round(_max * scale)
+        lower = upper - self.levels
+        level = np.clip(np.round(x * scale), lower, upper)
+        return (_min, _max), (level - lower).astype(np.uint8)
+
+    def decompress(self, minmax, compressed: np.ndarray) -> np.ndarray:
+        _min, _max = minmax
+        scale = self.levels / (_max - _min + self.eps)
+        upper = np.round(_max * scale)
+        lower = upper - self.levels
+        return (compressed.astype(np.float32) + lower) / scale

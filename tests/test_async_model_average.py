@@ -1,0 +1,67 @@
+"""Async model averaging: convergence + abort/resume behavior
+(mirrors /root/reference/tests/torch_api/test_async_model_average.py:86-110)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bagua_tpu import BaguaTrainer
+from bagua_tpu.algorithms import AsyncModelAverageAlgorithm
+from bagua_tpu.models import MLP
+
+N = 8
+DIM, NCLASS = 10, 5
+
+
+def _setup(seed=0):
+    model = MLP(features=(12, NCLASS))
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, DIM)))["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"]).mean()
+
+    return model, params, loss_fn
+
+
+def test_convergence_with_background_averaging():
+    model, params, loss_fn = _setup()
+    algo = AsyncModelAverageAlgorithm(sync_interval_ms=0, warmup_steps=2)
+    trainer = BaguaTrainer(loss_fn, optax.sgd(0.05), algo)
+    st = trainer.init(params)
+    rng = np.random.default_rng(0)
+    W = rng.normal(size=(DIM, NCLASS))
+    losses = []
+    for _ in range(20):
+        x = rng.normal(size=(N * 4, DIM)).astype(np.float32)
+        y = np.argmax(x @ W, 1).astype(np.int32)
+        st, loss = trainer.train_step(st, {"x": jnp.asarray(x), "y": jnp.asarray(y)})
+        losses.append(float(loss))
+    st = algo.barrier(trainer, st)
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(losses))
+
+
+def test_abort_resume():
+    model, params, loss_fn = _setup(1)
+    algo = AsyncModelAverageAlgorithm(sync_interval_ms=0)
+    trainer = BaguaTrainer(loss_fn, optax.sgd(0.05), algo)
+    st = trainer.init(params)
+    rng = np.random.default_rng(1)
+    W = rng.normal(size=(DIM, NCLASS))
+
+    def run(st, k):
+        for _ in range(k):
+            x = rng.normal(size=(N * 4, DIM)).astype(np.float32)
+            y = np.argmax(x @ W, 1).astype(np.int32)
+            st, loss = trainer.train_step(st, {"x": jnp.asarray(x), "y": jnp.asarray(y)})
+        return st, float(loss)
+
+    st, _ = run(st, 5)
+    algo.abort()
+    st, l1 = run(st, 5)   # trains without averaging
+    algo.resume()
+    st, l2 = run(st, 5)
+    st = algo.barrier(trainer, st)
+    assert np.isfinite(l1) and np.isfinite(l2)

@@ -1,0 +1,147 @@
+"""Golden-model equivalence for decentralized SGD.
+
+Mirrors /root/reference/tests/torch_api/test_decentralized.py: a pure
+reimplementation of the same math (per-rank host loop, same peer formula)
+compared elementwise, plus the ``all``-mode invariant that all ranks end up
+identical (:290-315)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu import BaguaTrainer
+from bagua_tpu.algorithms import DecentralizedAlgorithm, shift_one_peer
+from bagua_tpu.models import MLP
+
+N = 8
+DIM, NCLASS = 10, 5
+LR = 0.05
+
+
+def _setup(seed=0):
+    model = MLP(features=(12, NCLASS))
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, DIM)))["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"]).mean()
+
+    return model, params, loss_fn
+
+
+def _batches(steps, seed=0):
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(DIM, NCLASS))
+    out = []
+    for _ in range(steps):
+        x = rng.normal(size=(N * 4, DIM)).astype(np.float32)
+        y = np.argmax(x @ W, 1).astype(np.int32)
+        out.append({"x": x, "y": y})
+    return out
+
+
+def test_shift_one_peer_formula_is_symmetric_pairing():
+    for n in (4, 8, 16):
+        for step in range(2 * n):
+            peers = [shift_one_peer(r, n, step) for r in range(n)]
+            for r in range(n):
+                assert peers[peers[r]] == r, (n, step, peers)
+            assert sorted(peers) == list(range(n))
+
+
+@pytest.mark.parametrize("mode", ["all", "shift_one"])
+def test_matches_per_rank_golden(mode):
+    model, params, loss_fn = _setup()
+    steps = 4
+    batches = _batches(steps)
+
+    algo = DecentralizedAlgorithm(hierarchical=False, peer_selection_mode=mode)
+    trainer = BaguaTrainer(loss_fn, optax.sgd(LR), algo, bucket_bytes=10 ** 9)
+    st = trainer.init(params)
+    for b in batches:
+        st, _ = trainer.train_step(st, {"x": jnp.asarray(b["x"]), "y": jnp.asarray(b["y"])})
+
+    # golden: explicit per-rank host loop with the same math
+    grad_fn = jax.jit(jax.grad(loss_fn))
+    ranks = [params for _ in range(N)]
+    per = len(batches[0]["x"]) // N
+    for step, b in enumerate(batches):
+        grads = []
+        for r in range(N):
+            shard = {
+                "x": jnp.asarray(b["x"][r * per:(r + 1) * per]),
+                "y": jnp.asarray(b["y"][r * per:(r + 1) * per]),
+            }
+            grads.append(grad_fn(ranks[r], shard))
+        if mode == "all":
+            mean = jax.tree.map(lambda *xs: sum(xs) / N, *ranks)
+            averaged = [mean] * N
+        else:
+            averaged = [None] * N
+            for r in range(N):
+                p = shift_one_peer(r, N, step)
+                averaged[r] = jax.tree.map(lambda a, b_: (a + b_) * 0.5, ranks[r], ranks[p])
+        ranks = [
+            jax.tree.map(lambda p_, g: p_ - LR * g, averaged[r], grads[r])
+            for r in range(N)
+        ]
+
+    got = np.stack([np.concatenate([np.ravel(l) for l in jax.tree.leaves(
+        jax.tree.map(lambda x: x[r], st.params))]) for r in range(N)])
+    want = np.stack([np.concatenate([np.ravel(np.asarray(l)) for l in jax.tree.leaves(ranks[r])])
+                     for r in range(N)])
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-5)
+
+
+def test_all_mode_ranks_identical():
+    model, params, loss_fn = _setup(1)
+    trainer = BaguaTrainer(
+        loss_fn, optax.sgd(LR),
+        DecentralizedAlgorithm(hierarchical=False, peer_selection_mode="all"),
+    )
+    st = trainer.init(params)
+    for b in _batches(3, seed=1):
+        st, _ = trainer.train_step(st, {"x": jnp.asarray(b["x"]), "y": jnp.asarray(b["y"])})
+    # after the averaging step all ranks saw the same pre-step weights but
+    # applied different local grads; average again to compare the invariant:
+    # rank weights must all equal (weights diverge only by one local step)
+    leaves = jax.tree.leaves(st.params)
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        # invariant from reference test: in "all" mode peers coincide after
+        # communication; our state is post-step so check spread is the size
+        # of one SGD step, not divergent
+        assert np.abs(arr - arr.mean(axis=0, keepdims=True)).max() < LR * 50
+
+
+def test_hierarchical_single_host_equals_all_average():
+    model, params, loss_fn = _setup(2)
+    batches = _batches(3, seed=2)
+
+    outs = []
+    for algo in [
+        DecentralizedAlgorithm(hierarchical=True, peer_selection_mode="all"),
+        DecentralizedAlgorithm(hierarchical=False, peer_selection_mode="all"),
+    ]:
+        trainer = BaguaTrainer(loss_fn, optax.sgd(LR), algo)
+        st = trainer.init(params)
+        for b in batches:
+            st, _ = trainer.train_step(st, {"x": jnp.asarray(b["x"]), "y": jnp.asarray(b["y"])})
+        outs.append(st.params)
+
+    for a, b in zip(jax.tree.leaves(outs[0]), jax.tree.leaves(outs[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_communication_interval():
+    model, params, loss_fn = _setup(3)
+    algo = DecentralizedAlgorithm(
+        hierarchical=False, peer_selection_mode="all", communication_interval=2
+    )
+    trainer = BaguaTrainer(loss_fn, optax.sgd(LR), algo)
+    st = trainer.init(params)
+    for b in _batches(4, seed=3):
+        st, loss = trainer.train_step(st, {"x": jnp.asarray(b["x"]), "y": jnp.asarray(b["y"])})
+    assert np.isfinite(float(loss))
