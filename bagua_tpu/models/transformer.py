@@ -1,0 +1,177 @@
+"""Decoder-only Transformer LM — the flagship model for benchmarks and the
+driver's compile checks.
+
+The reference's headline language workload is BERT-Large SQuAD finetuning
+(/root/reference/examples/squad/main.py); this is the equivalent first-class
+transformer family, designed TPU-first rather than ported:
+
+- all matmuls in bfloat16 (MXU-native), params kept in f32,
+- static shapes and a static causal mask (XLA tiles cleanly onto the MXU),
+- head/ffn dims kept at multiples of 128 (MXU lane width),
+- optional ``jax.checkpoint`` over blocks to trade FLOPs for HBM,
+- attention pluggable so the sequence-parallel paths (ring attention /
+  Ulysses all-to-all, SURVEY.md §5.7) drop in without touching the model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def bert_large_config(**kw) -> TransformerConfig:
+    """BERT-Large-scale shapes (the reference's SQuAD workload scale)."""
+    return TransformerConfig(
+        vocab_size=30528, d_model=1024, n_heads=16, n_layers=24, d_ff=4096,
+        max_seq_len=512, **kw,
+    )
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype
+        )
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + 1e-6)
+        return (y * scale).astype(self.dtype)
+
+
+def causal_attention(q, k, v, dtype):
+    """Plain causal attention; softmax in f32, matmuls in ``dtype``.
+
+    ``q/k/v``: [batch, seq, heads, head_dim].  The SP paths (ring/Ulysses)
+    provide drop-in replacements with the same signature.
+    """
+    b, s, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
+    logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h, d = cfg.n_heads, cfg.head_dim
+        dense = lambda name: nn.DenseGeneral(
+            (h, d), axis=-1, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, use_bias=False,
+        )
+        q, k, v = dense("q")(x), dense("k")(x), dense("v")(x)
+        fn = self.attn_fn or causal_attention
+        o = fn(q, k, v, cfg.dtype)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), name="o", dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, use_bias=False,
+        )(o)
+
+
+class MLPBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="wi_gate")(x)
+        up = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name="wi_up")(x)
+        y = nn.silu(gate) * up
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="wo")(y)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+    mlp: Optional[Callable[[], nn.Module]] = None  # MoE drops in here
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        y = RMSNorm(cfg.dtype, cfg.param_dtype, name="attn_norm")(x)
+        x = x + Attention(cfg, self.attn_fn, name="attn")(y)
+        y = RMSNorm(cfg.dtype, cfg.param_dtype, name="mlp_norm")(x)
+        mlp = self.mlp() if self.mlp is not None else MLPBlock(cfg, name="mlp")
+        x = x + mlp(y)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: token ids [batch, seq] -> logits [batch, seq, vocab]."""
+
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+    mlp_factory: Optional[Callable[[int], Optional[Callable]]] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model, name="embed",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        )(tokens)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (cfg.max_seq_len, cfg.d_model), cfg.param_dtype,
+        )
+        x = x + pos[None, : tokens.shape[1]].astype(cfg.dtype)
+        block_cls = nn.checkpoint(Block) if cfg.remat else Block
+        for i in range(cfg.n_layers):
+            mlp = self.mlp_factory(i) if self.mlp_factory is not None else None
+            x = block_cls(cfg, self.attn_fn, mlp, name=f"block_{i}")(x)
+        x = RMSNorm(cfg.dtype, cfg.param_dtype, name="final_norm")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def lm_loss_fn(model: TransformerLM):
+    """Next-token cross-entropy; batch = dict(tokens=[b, s+1])."""
+
+    def loss_fn(params, batch):
+        import optax
+
+        tokens = batch["tokens"]
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tokens[:, 1:]
+        ).mean()
+
+    return loss_fn

@@ -1,0 +1,73 @@
+"""Benchmark: ResNet50 synthetic training throughput (img/s per chip).
+
+Mirrors the reference's CI benchmark (synthetic ImageNet batches through
+ResNet50 with the gradient_allreduce algorithm,
+/root/reference/.buildkite/scripts/benchmark_master.sh:83-98 and
+examples/benchmark/synthetic_benchmark.py).  Baseline: the reference's CI
+floor of 185 img/s per V100-class GPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BASELINE_IMGS_PER_SEC_PER_DEVICE = 185.0
+BATCH_PER_DEVICE = 32  # the reference CI floor was gated at batch 32
+IMAGE_SIZE = 224
+WARMUP_STEPS = 3
+TIMED_STEPS = 20
+
+
+def main():
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.models.resnet import ResNet50, classification_loss_fn
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = build_mesh({"dp": n_dev}, devices)
+
+    model = ResNet50(num_classes=1000)
+    batch = BATCH_PER_DEVICE * n_dev
+    images = jnp.zeros((batch, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
+    labels = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=True)
+    params = variables["params"]
+
+    trainer = BaguaTrainer(
+        classification_loss_fn(model, batch_stats=variables["batch_stats"]),
+        optax.sgd(0.1, momentum=0.9),
+        GradientAllReduceAlgorithm(),
+        mesh=mesh,
+    )
+    state = trainer.init(params)
+    data = {"images": images, "labels": labels}
+
+    for _ in range(WARMUP_STEPS):
+        state, loss = trainer.train_step(state, data)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, loss = trainer.train_step(state, data)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = TIMED_STEPS * batch / dt
+    per_device = imgs_per_sec / n_dev
+    print(json.dumps({
+        "metric": "resnet50_synthetic_imgs_per_sec_per_chip",
+        "value": round(per_device, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_device / BASELINE_IMGS_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Model smoke + training tests (tiny shapes, 8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.core.backend import BaguaTrainer
+from bagua_tpu.models.resnet import ResNet, classification_loss_fn
+from bagua_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    lm_loss_fn,
+)
+from bagua_tpu.parallel.mesh import build_mesh
+
+N_DEVICES = 8
+
+
+def tiny_lm():
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    return TransformerLM(cfg), cfg
+
+
+def test_transformer_forward_shape():
+    model, cfg = tiny_lm()
+    tokens = jnp.zeros((2, cfg.max_seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, cfg.max_seq_len, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_transformer_causality():
+    """Changing a future token must not change past logits."""
+    model, cfg = tiny_lm()
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (1, cfg.max_seq_len), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    logits = model.apply({"params": params}, tokens)
+    tokens2 = tokens.at[0, -1].set((tokens[0, -1] + 1) % cfg.vocab_size)
+    logits2 = model.apply({"params": params}, tokens2)
+    assert jnp.allclose(logits[0, :-1], logits2[0, :-1], atol=1e-5)
+
+
+def test_transformer_trains_dp():
+    model, cfg = tiny_lm()
+    mesh = build_mesh({"dp": N_DEVICES})
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (2 * N_DEVICES, cfg.max_seq_len + 1), 0,
+        cfg.vocab_size,
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens[:2, :-1])["params"]
+    trainer = BaguaTrainer(
+        lm_loss_fn(model), optax.adam(1e-2), GradientAllReduceAlgorithm(),
+        mesh=mesh,
+    )
+    state = trainer.init(params)
+    losses = []
+    for _ in range(10):
+        state, loss = trainer.train_step(state, {"tokens": tokens})
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_resnet_trains():
+    model = ResNet(stage_sizes=(1, 1), num_classes=4, num_filters=8,
+                   dtype=jnp.float32)
+    mesh = build_mesh({"dp": N_DEVICES})
+    images = jax.random.normal(jax.random.PRNGKey(0), (N_DEVICES * 2, 32, 32, 3))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (N_DEVICES * 2,), 0, 4)
+    variables = model.init(jax.random.PRNGKey(2), images[:2], train=True)
+    params = variables["params"]
+    trainer = BaguaTrainer(
+        classification_loss_fn(model, batch_stats=variables["batch_stats"]),
+        optax.sgd(0.05), GradientAllReduceAlgorithm(), mesh=mesh,
+    )
+    state = trainer.init(params)
+    losses = []
+    for _ in range(5):
+        state, loss = trainer.train_step(state, {"images": images,
+                                                 "labels": labels})
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
