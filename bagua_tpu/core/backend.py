@@ -114,6 +114,7 @@ class BaguaTrainer:
         self._autotune_completed = not self.autotune
         self._speed_tracker = StatisticalAverage()
         self._last_report_time = time.time()
+        self._last_speed_time = time.time()
         self._hyperparams_signature = None
 
     # ---- plan management -----------------------------------------------
@@ -147,6 +148,8 @@ class BaguaTrainer:
         # copy: step buffers are donated, the caller keeps their params alive
         params = jax.tree.map(lambda x: jnp.array(x, copy=True), params)
         self._plan = self._build_plan(params)
+        if self.autotune and not self._autotune_completed:
+            self._autotune_register_tensors()
         plan = self._plan
         algo = self.algorithm
         ctx = self._ctx(plan)
@@ -263,12 +266,55 @@ class BaguaTrainer:
 
     # ---- autotune check-in (reference distributed.py:213-242) ------------
 
+    def _autotune_register_tensors(self):
+        """Declare communicated tensors to the sidecar (reference
+        distributed.py:387-406)."""
+        from ..communication import get_hyperparameters_service_client
+
+        try:
+            if self._autotune_client is None:
+                self._autotune_client = get_hyperparameters_service_client()
+            rsp = self._autotune_client.register_tensors(
+                model_name=self.model_name,
+                tensor_list=[p.declaration().model_dump() for p in self._named_params],
+            )
+            # apply the service's initial recommendation so trainer and
+            # service agree on the config the first score is attributed to
+            # (reference distributed.py:387-406)
+            from ..define import BaguaHyperparameter
+
+            rec = BaguaHyperparameter(**rsp.get("recommended_hyperparameters", {}))
+            self._apply_recommendation(rec)
+        except Exception as e:  # autotune must never take down training
+            logger.warning("autotune register_tensors failed: %s", e)
+            self.autotune = False
+
+    def _apply_recommendation(self, recommended) -> None:
+        if recommended.buckets:
+            named_by_name = {p.name: p for p in self._named_params}
+            decl_buckets = [
+                [d for d in bucket if d.name in named_by_name]
+                for bucket in recommended.buckets
+            ]
+            decl_buckets = [b for b in decl_buckets if b]
+            if decl_buckets:
+                self.rebucket(decl_buckets)
+                self.bucket_bytes = recommended.bucket_size
+        # hierarchical toggle is only meaningful when the mesh has both tiers
+        if self._inter is not None and self._intra is not None:
+            self.algorithm.hierarchical = bool(recommended.is_hierarchical_reduce)
+
     def _autotune_step(self, state):
         from ..communication import get_hyperparameters_service_client
         from ..define import BaguaHyperparameter
 
         rank = env.get_rank()
-        speed = self._speed_tracker.total()
+        now = time.time()
+        # windowed throughput since the last report (reference
+        # distributed.py:223), NOT a cumulative total — the score must
+        # reflect only the current hyperparameter config
+        speed = self._speed_tracker.get(now - self._last_report_time)
+        self._last_report_time = now
         try:
             if self._autotune_client is None:
                 self._autotune_client = get_hyperparameters_service_client()
@@ -277,7 +323,7 @@ class BaguaTrainer:
                 model_name=self.model_name,
                 rank=rank,
                 train_iter=self._step_counter,
-                hyperparameters=self._current_hyperparameters().dict(),
+                hyperparameters=self._current_hyperparameters().model_dump(),
                 speed=speed,
             )
             rsp = client.ask_hyperparameters(
@@ -285,14 +331,7 @@ class BaguaTrainer:
             )
             recommended = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
             self._autotune_completed = bool(rsp.get("is_autotune_completed", False))
-            if recommended.buckets:
-                named_by_name = {p.name: p for p in self._named_params}
-                decl_buckets = [
-                    [d for d in bucket if d.name in named_by_name]
-                    for bucket in recommended.buckets
-                ]
-                decl_buckets = [b for b in decl_buckets if b]
-                self.rebucket(decl_buckets)
+            self._apply_recommendation(recommended)
         except Exception as e:  # autotune must never take down training
             logger.warning("autotune check-in failed: %s", e)
 
@@ -300,7 +339,7 @@ class BaguaTrainer:
         from ..define import BaguaHyperparameter
 
         buckets = [
-            [t.declaration().dict() for t in b.tensors] for b in self._plan.buckets
+            [t.declaration().model_dump() for t in b.tensors] for b in self._plan.buckets
         ] if self._plan else []
         from ..define import TensorDeclaration
 
@@ -311,6 +350,10 @@ class BaguaTrainer:
         )
 
     def record_speed(self, n_samples: float):
-        """Feed the throughput tracker (reference's speed metrics,
-        distributed.py:340-358)."""
-        self._speed_tracker.record(n_samples)
+        """Feed the throughput tracker with an instantaneous rate
+        (reference's speed metrics, distributed.py:340-358)."""
+        now = time.time()
+        dt = now - self._last_speed_time
+        self._last_speed_time = now
+        if dt > 0:
+            self._speed_tracker.record(n_samples / dt)

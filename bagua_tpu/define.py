@@ -56,9 +56,13 @@ class BaguaHyperparameter(BaseModel):
     buckets: List[List[TensorDeclaration]] = []
     is_hierarchical_reduce: bool = False
     bucket_size: int = 10 * 1024 ** 2
+    #: algorithm family recommended by the autotuner ("" = keep current);
+    #: TPU extension over the reference — BASELINE.json requires the
+    #: centralized/decentralized/low-precision families to be selectable
+    algorithm: str = ""
 
     def update(self, param_dict: dict) -> "BaguaHyperparameter":
-        tmp = self.dict()
+        tmp = self.model_dump()
         tmp.update(param_dict)
         for key, value in param_dict.items():
             if key in tmp:

