@@ -8,3 +8,14 @@ from .decentralized import (  # noqa: F401
 )
 from .gradient_allreduce import GradientAllReduceAlgorithm  # noqa: F401
 from .q_adam import QAdamAlgorithm, QAdamOptState  # noqa: F401
+
+#: Families the autotuner may switch between at a check-in (stateless,
+#: replicated, trainer-owned-optimizer algorithms only — swapping them never
+#: invalidates TrainState).  Gossip/owner families change the state layout
+#: and must be chosen up front.
+SWITCHABLE_ALGORITHMS = {
+    "gradient_allreduce": lambda hierarchical: GradientAllReduceAlgorithm(
+        hierarchical=hierarchical
+    ),
+    "bytegrad": lambda hierarchical: ByteGradAlgorithm(hierarchical=hierarchical),
+}

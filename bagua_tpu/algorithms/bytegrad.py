@@ -18,6 +18,8 @@ from .base import Algorithm, AlgorithmContext
 
 
 class ByteGradAlgorithm(Algorithm):
+    name = "bytegrad"
+
     def __init__(self, hierarchical: bool = True, average: bool = True):
         """
         Args:

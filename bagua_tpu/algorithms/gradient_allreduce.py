@@ -15,6 +15,8 @@ from .base import Algorithm, AlgorithmContext
 
 
 class GradientAllReduceAlgorithm(Algorithm):
+    name = "gradient_allreduce"
+
     def __init__(self, hierarchical: bool = False, average: bool = True):
         """
         Args:

@@ -245,6 +245,7 @@ def start_autotune_server():
             warmup_time_s=env.get_autotune_warmup_time_s(),
             is_output_autotune_log=env.is_output_autotune_log(),
             default_bucket_size=env.get_default_bucket_size(),
+            tune_algorithm=env.is_autotune_algorithm_on(),
         ),
         daemon=True,
     )

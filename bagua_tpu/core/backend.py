@@ -33,7 +33,7 @@ from ..algorithms.base import Algorithm, AlgorithmContext
 from ..bucket import BucketPlan, split_bucket_by_bucket_size
 from ..communication import BaguaCommunicator, ReduceOp, collapse_trivial_axes
 from ..parallel.mesh import build_mesh, hierarchical_mesh, mesh_axis_size
-from ..tensor import build_params
+from ..tensor import build_params, _name_of_path
 from ..utils import StatisticalAverage
 
 logger = logging.getLogger(__name__)
@@ -73,7 +73,13 @@ class BaguaTrainer:
         model_name: str = "bagua_module",
         autotune: Optional[bool] = None,
         donate: bool = True,
+        expert_axis: Optional[str] = None,
+        expert_keyword: str = "expert",
     ):
+        """``expert_axis``: mesh axis carrying expert parallelism (MoE).
+        Params whose name contains ``expert_keyword`` are sharded over it and
+        excluded from the data-parallel bucket plan (reference
+        ``param.expert`` flags, moe/experts.py:26-29 + distributed.py:66)."""
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.algorithm = algorithm
@@ -88,17 +94,34 @@ class BaguaTrainer:
                 else build_mesh()
             )
         self.mesh = mesh
+        self.expert_axis = (
+            expert_axis if expert_axis and expert_axis in mesh.axis_names else None
+        )
+        self.expert_keyword = expert_keyword
         if dp_axes is None:
-            dp_axes = tuple(a for a in mesh.axis_names if a in ("dp", "inter", "intra"))
-            if not dp_axes:
+            dp_axes = tuple(
+                a for a in mesh.axis_names
+                if a in ("dp", "inter", "intra") and a != self.expert_axis
+            )
+            if not dp_axes and self.expert_axis is None:
                 dp_axes = (mesh.axis_names[0],)
         self.dp_axes = tuple(dp_axes)
-        self.world_size = mesh_axis_size(mesh, self.dp_axes)
+        if self.expert_axis is not None and not algorithm.replicated_params:
+            raise NotImplementedError(
+                "expert parallelism with gossip (per-rank-weight) algorithms "
+                "is not supported yet"
+            )
+        # the batch is sharded over dp AND ep, so dense-grad comm spans both;
+        # expert grads are only averaged over dp (experts differ across ep)
+        self.comm_axes = self.dp_axes + (
+            (self.expert_axis,) if self.expert_axis else ()
+        )
+        self.world_size = mesh_axis_size(mesh, self.comm_axes)
         self.bucket_bytes = bucket_bytes or env.get_default_bucket_size()
         self.model_name = model_name
         self.donate = donate
 
-        comm = BaguaCommunicator(collapse_trivial_axes(mesh, self.dp_axes), mesh)
+        comm = BaguaCommunicator(collapse_trivial_axes(mesh, self.comm_axes), mesh)
         inter = BaguaCommunicator("inter", mesh) if "inter" in mesh.axis_names else None
         intra = BaguaCommunicator("intra", mesh) if "intra" in mesh.axis_names else None
         self._comm, self._inter, self._intra = comm, inter, intra
@@ -111,6 +134,7 @@ class BaguaTrainer:
 
         self.autotune = env.get_autotune_level() >= 1 if autotune is None else autotune
         self._autotune_client = None
+        self._autotune_failures = 0
         self._autotune_completed = not self.autotune
         self._speed_tracker = StatisticalAverage()
         self._last_report_time = time.time()
@@ -128,8 +152,14 @@ class BaguaTrainer:
             world_size=self.world_size,
         )
 
+    def _is_expert_name(self, name: str) -> bool:
+        return self.expert_axis is not None and self.expert_keyword in name
+
     def _build_plan(self, params) -> BucketPlan:
-        named = self.algorithm.init_tensors(build_params(params))
+        candidates = [
+            p for p in build_params(params) if not self._is_expert_name(p.name)
+        ]
+        named = self.algorithm.init_tensors(candidates)
         self._named_params = named
         decls = [p.declaration() for p in named]
         decl_buckets = split_bucket_by_bucket_size(decls, self.bucket_bytes)
@@ -159,6 +189,34 @@ class BaguaTrainer:
             opt_init = algo.init_optimizer_state
         else:
             opt_init = self.optimizer.init
+
+        if self.expert_axis is not None:
+            # everything is stacked per ep-rank (leading axis sharded over
+            # 'ep'): expert leaves enter as global [n_experts, ...] and are
+            # split; dense leaves are replicated copies kept in lockstep by
+            # the dense-grad allreduce
+            ep = self.expert_axis
+
+            def leaf_spec(path, leaf):
+                return P(ep) if self._is_expert_name(_name_of_path(path)) else P()
+
+            in_specs = jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+            def init_fn(p):
+                a = algo.init_state(ctx, p)
+                o = opt_init(p)
+                stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+                return stack(p), stack(o), stack(a)
+
+            out_spec = P((ep,))
+            p_stacked, opt_state, algo_state = jax.jit(
+                shard_map(init_fn, mesh=mesh, in_specs=(in_specs,),
+                          out_specs=(out_spec, out_spec, out_spec),
+                          check_vma=False)
+            )(params)
+            return TrainState(
+                jnp.zeros((), jnp.int32), p_stacked, opt_state, algo_state
+            )
 
         if algo.replicated_params:
             opt_state = jax.jit(opt_init)(params)
@@ -194,12 +252,17 @@ class BaguaTrainer:
         mesh = self.mesh
         dp = self.dp_axes
         replicated = algo.replicated_params
+        expert = self.expert_axis
+        # per-shard state is stacked (leading rank axis) for gossip
+        # algorithms and for expert parallelism
+        stacked = (not replicated) or expert is not None
+        expert_dp = tuple(a for a in dp if mesh.shape[a] > 1)
 
         def per_shard(state: TrainState, batch):
             params = state.params
             opt_state = state.opt_state
             algo_state = state.algo_state
-            if not replicated:
+            if stacked:
                 unstack = lambda t: jax.tree.map(lambda x: x[0], t)
                 params, opt_state, algo_state = (
                     unstack(params), unstack(opt_state), unstack(algo_state)
@@ -208,6 +271,16 @@ class BaguaTrainer:
 
             loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
             grads, algo_state = algo.process_grads(ctx, grads, params, algo_state, step)
+            if expert is not None and expert_dp:
+                # expert grads bypass the bucket plan; they are replicated
+                # over dp only (each ep shard owns different experts)
+                grads = jax.tree_util.tree_map_with_path(
+                    lambda path, g: (
+                        jax.lax.pmean(g, expert_dp)
+                        if self._is_expert_name(_name_of_path(path)) else g
+                    ),
+                    grads,
+                )
             params, algo_state = algo.process_pre_step(ctx, params, algo_state, step)
             if algo.owns_optimizer:
                 params, opt_state, algo_state = algo.optimizer_update(
@@ -219,16 +292,20 @@ class BaguaTrainer:
             params, algo_state = algo.process_post_step(ctx, params, algo_state, step)
 
             loss = ctx.comm.allreduce(loss, ReduceOp.AVG)
-            if not replicated:
+            if stacked:
                 stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
                 params, opt_state, algo_state = (
                     stack(params), stack(opt_state), stack(algo_state)
                 )
             return TrainState(state.step + 1, params, opt_state, algo_state), loss
 
-        pspec = P() if replicated else P(dp)
+        if expert is not None:
+            pspec = P((expert,))
+            batch_spec = P(dp + (expert,))
+        else:
+            pspec = P() if replicated else P(dp)
+            batch_spec = P(dp)
         state_specs = TrainState(step=P(), params=pspec, opt_state=pspec, algo_state=pspec)
-        batch_spec = P(dp)
 
         fn = shard_map(
             per_shard,
@@ -240,7 +317,12 @@ class BaguaTrainer:
         return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
 
     def _get_step_fn(self):
-        key = (self._plan.signature(), self._phase, self.algorithm.hierarchical)
+        key = (
+            self._plan.signature(),
+            self._phase,
+            self.algorithm.hierarchical,
+            type(self.algorithm).__name__,
+        )
         if key not in self._step_cache:
             logger.info("bagua_tpu: compiling train step (phase=%s, %d buckets)",
                         self._phase, len(self._plan.buckets))
@@ -290,6 +372,7 @@ class BaguaTrainer:
             self.autotune = False
 
     def _apply_recommendation(self, recommended) -> None:
+        self._maybe_switch_algorithm(recommended)
         if recommended.buckets:
             named_by_name = {p.name: p for p in self._named_params}
             decl_buckets = [
@@ -303,6 +386,30 @@ class BaguaTrainer:
         # hierarchical toggle is only meaningful when the mesh has both tiers
         if self._inter is not None and self._intra is not None:
             self.algorithm.hierarchical = bool(recommended.is_hierarchical_reduce)
+
+    def _maybe_switch_algorithm(self, recommended) -> None:
+        """Swap the algorithm family if the autotuner asked for one
+        (BAGUA_AUTOTUNE_ALGORITHM=1).  Only stateless replicated families
+        are swappable — the TrainState layout must not change."""
+        from ..algorithms import SWITCHABLE_ALGORITHMS
+
+        target = recommended.algorithm
+        current = getattr(self.algorithm, "name", None)
+        if (
+            not target
+            or target == current
+            or current not in SWITCHABLE_ALGORITHMS
+            or target not in SWITCHABLE_ALGORITHMS
+        ):
+            return
+        logger.info("autotune: switching algorithm %s -> %s", current, target)
+        self.algorithm = SWITCHABLE_ALGORITHMS[target](
+            bool(recommended.is_hierarchical_reduce)
+        )
+        # rebuild the plan: bucket alignment differs between families
+        # (ByteGrad pads buckets to the world size)
+        self.rebucket([[t.declaration() for t in b.tensors]
+                       for b in self._plan.buckets])
 
     def _autotune_step(self, state):
         from ..communication import get_hyperparameters_service_client
@@ -332,8 +439,16 @@ class BaguaTrainer:
             recommended = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
             self._autotune_completed = bool(rsp.get("is_autotune_completed", False))
             self._apply_recommendation(recommended)
+            self._autotune_failures = 0
         except Exception as e:  # autotune must never take down training
-            logger.warning("autotune check-in failed: %s", e)
+            self._autotune_failures += 1
+            logger.warning("autotune check-in failed (%d/3): %s",
+                           self._autotune_failures, e)
+            if self._autotune_failures >= 3:
+                # a dead sidecar would otherwise stall every 100th step on
+                # connection timeouts for the rest of the run
+                logger.warning("autotune disabled after repeated failures")
+                self.autotune = False
 
     def _current_hyperparameters(self):
         from ..define import BaguaHyperparameter
@@ -348,6 +463,22 @@ class BaguaTrainer:
             is_hierarchical_reduce=bool(self.algorithm.hierarchical),
             bucket_size=self.bucket_bytes,
         )
+
+    def unstack_params(self, state: TrainState):
+        """Return params in user shape (for eval/checkpoint): rank 0's copy
+        for replicated/gossip state; global ``[n_experts, ...]`` expert leaves
+        re-assembled from their ep shards."""
+        if self.expert_axis is None:
+            if self.algorithm.replicated_params:
+                return state.params
+            return jax.tree.map(lambda x: x[0], state.params)
+
+        def fix(path, leaf):
+            if self._is_expert_name(_name_of_path(path)):
+                return leaf.reshape((-1,) + leaf.shape[2:])
+            return leaf[0]
+
+        return jax.tree_util.tree_map_with_path(fix, state.params)
 
     def record_speed(self, n_samples: float):
         """Feed the throughput tracker with an instantaneous rate

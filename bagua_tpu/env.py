@@ -86,6 +86,12 @@ def get_autotune_warmup_time_s() -> float:
     return float(os.environ.get("BAGUA_AUTOTUNE_WARMUP_TIME_S", 30.0))
 
 
+def is_autotune_algorithm_on() -> bool:
+    """Let the autotuner search over algorithm families too (TPU extension;
+    BASELINE.json wants centralized/low-precision selectable)."""
+    return _int_env("BAGUA_AUTOTUNE_ALGORITHM", 0) == 1
+
+
 def is_report_metrics_switch_on() -> bool:
     return _int_env("BAGUA_REPORT_METRICS", 0) == 1
 

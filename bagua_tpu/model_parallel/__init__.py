@@ -1,0 +1,1 @@
+"""Model-parallel building blocks (reference ``bagua/torch_api/model_parallel``)."""

@@ -1,0 +1,5 @@
+"""Mixture-of-Experts with expert parallelism (reference
+``bagua/torch_api/model_parallel/moe/``)."""
+
+from .gating import top1_gating, top2_gating  # noqa: F401
+from .layer import MoEMLP, moe_lm_loss_fn  # noqa: F401

@@ -1,0 +1,161 @@
+"""Expert-parallel MoE layer.
+
+Counterpart of /root/reference/bagua/torch_api/model_parallel/moe/layer.py:22
+(``MoE``) + sharded_moe.py:306 (``MOELayer``: gate → einsum dispatch →
+all-to-all → local experts → all-to-all → einsum combine) + experts.py
+(expert params flagged so DP averaging skips them, experts.py:26-29).
+
+TPU-first shape: the all-to-all is ``lax.all_to_all`` over an ``'ep'`` mesh
+axis inside the jitted step (the reference drives
+``torch.distributed.all_to_all_single`` from autograd, sharded_moe.py:77-90);
+expert weights live as one leaf ``[n_experts, ...]`` sharded over ``'ep'``,
+batched per-expert matmuls run on the MXU via a single einsum.  Parameters
+whose name contains ``"expert"`` are excluded from the data-parallel bucket
+plan by the trainer (the analog of ``param.expert`` flags).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .gating import top1_gating, top2_gating
+
+
+def _axis_bound(name: str) -> bool:
+    """True when ``name`` is a live mesh axis (i.e. we're inside shard_map)."""
+    try:
+        lax.axis_index(name)
+        return True
+    except NameError:
+        return False
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP replacement: tokens [batch, seq, d_model] -> same.
+
+    Plugs into ``TransformerLM`` via ``mlp_factory``.  ``ep_size`` is the
+    static expert-parallel degree (= mesh ``'ep'`` axis size); each shard owns
+    ``n_experts // ep_size`` experts.  Outside shard_map (e.g. ``model.init``)
+    the all-to-all is skipped and only the local expert slice is computed —
+    parameter shapes are identical, so init-outside / apply-inside works.
+    """
+
+    n_experts: int
+    d_ff: int
+    ep_size: int = 1
+    k: int = 2                      # top-k routing (1 or 2)
+    capacity_factor: float = 1.25
+    axis_name: str = "ep"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        assert self.n_experts % self.ep_size == 0
+        n_local = self.n_experts // self.ep_size
+        b, s, d = x.shape
+        tokens = b * s
+        xt = x.reshape(tokens, d)
+
+        # router in f32 (small, precision-sensitive; reference TopKGate
+        # casts to fp32 too, sharded_moe.py:241-303)
+        logits = nn.Dense(
+            self.n_experts, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="router",
+        )(xt.astype(jnp.float32))
+        capacity = max(1, math.ceil(self.k * tokens * self.capacity_factor
+                                    / self.n_experts))
+        gate = top1_gating if self.k == 1 else top2_gating
+        dispatch, combine, l_aux = gate(logits, capacity)
+        self.sow("intermediates", "l_aux", l_aux)
+
+        # dispatch: [T,E,C] x [T,d] -> [E,C,d]
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(self.dtype), xt.astype(self.dtype)
+        )
+
+        inside_mesh = self.ep_size > 1 and _axis_bound(self.axis_name)
+        if inside_mesh:
+            # [E, C, d] -> [E/ep, ep*C, d]: expert shards receive their
+            # tokens from every ep peer
+            expert_in = lax.all_to_all(
+                expert_in, self.axis_name, split_axis=0, concat_axis=1,
+                tiled=True,
+            )
+        elif self.ep_size > 1:
+            # init path (outside shard_map): only shapes matter
+            expert_in = expert_in[:n_local]
+
+        wi = self.param(
+            "expert_wi", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (n_local, d, self.d_ff), self.param_dtype,
+        )
+        wo = self.param(
+            "expert_wo", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (n_local, self.d_ff, d), self.param_dtype,
+        )
+        h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(self.dtype)))
+        out = jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+
+        if inside_mesh:
+            out = lax.all_to_all(
+                out, self.axis_name, split_axis=1, concat_axis=0, tiled=True
+            )
+        elif self.ep_size > 1:
+            out = jnp.concatenate(
+                [out] + [jnp.zeros_like(out)] * (self.ep_size - 1), axis=0
+            )
+
+        y = jnp.einsum("tec,ecd->td", combine.astype(self.dtype), out)
+        return y.reshape(b, s, d)
+
+
+def globalize_expert_params(params, rng, ep_size: int, keyword: str = "expert"):
+    """Re-draw expert leaves at global shape for the expert-parallel trainer.
+
+    ``model.init`` outside the mesh yields expert leaves of LOCAL shape
+    ``[n_experts/ep_size, ...]`` (identical on every rank — a bad symmetric
+    init).  This expands each such leaf to ``[n_experts, ...]`` with an
+    independent per-expert draw; ``BaguaTrainer(expert_axis=...)`` then shards
+    the leading dim over ``'ep'``.  The returned tree is only valid inside the
+    trainer (direct ``model.apply`` would see a shape mismatch).
+    """
+    init = nn.initializers.lecun_normal(batch_axis=(0,))
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        if keyword in name and ep_size > 1:
+            rng, sub = jax.random.split(rng)
+            shape = (leaf.shape[0] * ep_size,) + leaf.shape[1:]
+            out.append(init(sub, shape, leaf.dtype))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def moe_lm_loss_fn(model, aux_loss_weight: float = 0.01):
+    """Next-token loss + load-balancing aux loss collected from every MoE
+    layer (the reference accumulates ``l_aux`` per gate, sharded_moe.py:354)."""
+    import optax
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        logits, mutated = model.apply(
+            {"params": params}, tokens[:, :-1], mutable=["intermediates"]
+        )
+        nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits, tokens[:, 1:]
+        ).mean()
+        aux = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree.leaves(mutated.get("intermediates", {})):
+            aux = aux + jnp.sum(leaf)
+        return nll + aux_loss_weight * aux
+
+    return loss_fn

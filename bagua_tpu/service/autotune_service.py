@@ -39,7 +39,8 @@ class _TaskState:
         self.model_name = model_name
         self.lock = threading.Lock()
         self.manager = AutotuneTaskManager(
-            model_name, service.is_output_autotune_log
+            model_name, service.is_output_autotune_log,
+            tune_algorithm=service.tune_algorithm,
         )
         self.tensor_list: List[TensorDeclaration] = []
         self.recommended = BaguaHyperparameter(
@@ -52,6 +53,11 @@ class _TaskState:
         self.iter_by_rank: Dict[int, int] = {}
         self.n_samples = 0
         self.completed = False
+        # per-round decision cache: every rank asking at the same train_iter
+        # must receive the SAME recommendation, or the ranks' compiled SPMD
+        # programs diverge and their collectives deadlock (trainers check in
+        # at deterministic iterations, so train_iter identifies the round)
+        self.decisions: Dict[int, dict] = {}
 
 
 class AutotuneService:
@@ -64,6 +70,7 @@ class AutotuneService:
         warmup_time_s: float = 30.0,
         is_output_autotune_log: bool = False,
         default_bucket_size: int = 10 * 1024 ** 2,
+        tune_algorithm: bool = False,
     ):
         self.world_size = world_size
         self.autotune_level = autotune_level
@@ -72,6 +79,7 @@ class AutotuneService:
         self.warmup_time_s = warmup_time_s
         self.is_output_autotune_log = is_output_autotune_log
         self.default_bucket_size = default_bucket_size
+        self.tune_algorithm = tune_algorithm
         self._tasks: Dict[str, _TaskState] = {}
         self._tasks_lock = threading.Lock()
 
@@ -133,45 +141,52 @@ class AutotuneService:
         now = time.time()
         with task.lock:
             task.iter_by_rank[rank] = train_iter
-            if task.first_ask_time is None:
-                task.first_ask_time = now
-                task.sample_start_time = now
-            if self.autotune_level < 1 or task.completed:
-                return self._reply(task)
-            if now - task.first_ask_time < self.warmup_time_s:
-                return self._reply(task)
-            # confidence gate: the current point must have run long enough,
-            # and every rank must have trained past the point's start iter
-            all_ranks_in = len(task.iter_by_rank) >= self.world_size and all(
-                it > task.sample_start_iter for it in task.iter_by_rank.values()
-            )
-            long_enough = (
-                now - task.sample_start_time >= self.sampling_confidence_time_s
-            )
-            if not (all_ranks_in and long_enough):
-                return self._reply(task)
-            score = sum(task.speed_by_rank.values())
-            task.manager.record_sample(train_iter, task.recommended, score)
-            next_hp = task.manager.ask_hyperparameters(
-                train_iter, task.tensor_list, task.recommended, score
-            )
-            task.n_samples += 1
-            if task.n_samples >= self.max_samples:
-                best = task.manager.best_hyperparameters(task.tensor_list)
-                task.recommended = best if best is not None else task.recommended
-                task.completed = True
-                task.manager.close()
-                logger.info(
-                    "autotune[%s] completed after %d samples: bucket=%d hier=%s",
-                    task.model_name, task.n_samples,
-                    task.recommended.bucket_size,
-                    task.recommended.is_hierarchical_reduce,
-                )
-            else:
-                task.recommended = next_hp
+            if train_iter in task.decisions:
+                return task.decisions[train_iter]
+            reply = self._decide(task, train_iter, now)
+            task.decisions[train_iter] = reply
+            for it in sorted(task.decisions)[:-8]:  # bound the cache
+                del task.decisions[it]
+            return reply
+
+    def _decide(self, task: _TaskState, train_iter: int, now: float) -> dict:
+        """Compute the round's reply; caller holds ``task.lock``."""
+        if task.first_ask_time is None:
+            task.first_ask_time = now
             task.sample_start_time = now
-            task.sample_start_iter = train_iter
+        if self.autotune_level < 1 or task.completed:
             return self._reply(task)
+        if now - task.first_ask_time < self.warmup_time_s:
+            return self._reply(task)
+        # confidence gate: the current point must have run long enough
+        long_enough = (
+            now - task.sample_start_time >= self.sampling_confidence_time_s
+        )
+        if not (train_iter > task.sample_start_iter and long_enough):
+            return self._reply(task)
+        score = sum(task.speed_by_rank.values())
+        task.manager.record_sample(train_iter, task.recommended, score)
+        next_hp = task.manager.ask_hyperparameters(
+            train_iter, task.tensor_list, task.recommended, score
+        )
+        task.n_samples += 1
+        if task.n_samples >= self.max_samples:
+            best = task.manager.best_hyperparameters(task.tensor_list)
+            task.recommended = best if best is not None else task.recommended
+            task.completed = True
+            task.manager.close()
+            logger.info(
+                "autotune[%s] completed after %d samples: bucket=%d hier=%s algo=%s",
+                task.model_name, task.n_samples,
+                task.recommended.bucket_size,
+                task.recommended.is_hierarchical_reduce,
+                task.recommended.algorithm or "-",
+            )
+        else:
+            task.recommended = next_hp
+        task.sample_start_time = now
+        task.sample_start_iter = train_iter
+        return self._reply(task)
 
     def _reply(self, task: _TaskState) -> dict:
         return {
@@ -238,6 +253,7 @@ def run_autotune_server(
     warmup_time_s: float = 30.0,
     is_output_autotune_log: bool = False,
     default_bucket_size: int = 10 * 1024 ** 2,
+    tune_algorithm: bool = False,
 ) -> None:
     """Blocking server entry (run in a daemon process by
     :func:`bagua_tpu.communication.start_autotune_server`)."""
@@ -249,6 +265,7 @@ def run_autotune_server(
         warmup_time_s=warmup_time_s,
         is_output_autotune_log=is_output_autotune_log,
         default_bucket_size=default_bucket_size,
+        tune_algorithm=tune_algorithm,
     )
     server = make_server(port, service)
     logger.info("autotune service listening on :%d", port)

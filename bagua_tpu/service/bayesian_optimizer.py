@@ -71,7 +71,6 @@ class BayesianOptimizer:
         self._rng = random.Random(seed)
         self._observations: List[Tuple[Dict, float]] = []
         self._ask_count = 0
-        self._pending: Optional[Dict] = None
 
     # -- space helpers ----------------------------------------------------
 

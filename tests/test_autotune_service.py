@@ -116,6 +116,85 @@ def test_execution_order_reorders_buckets(service_client):
     assert names == order
 
 
+def test_same_round_same_recommendation(service_client):
+    """All ranks asking at the same train_iter MUST get identical replies,
+    else their compiled SPMD programs diverge and collectives deadlock."""
+    service, client = service_client
+    decls = [t.model_dump() for t in tensor_list(n=8, numel=1000)]
+    client.register_tensors("mr", decls)
+    for it in range(1, 12):
+        for rank in range(2):
+            client.report_metrics("mr", rank, it, {}, 100.0)
+        replies = [
+            client.ask_hyperparameters("mr", rank, it) for rank in range(2)
+        ]
+        assert replies[0] == replies[1], f"divergent replies at iter {it}"
+
+
+def test_algorithm_family_tuning():
+    """With tune_algorithm on, the optimizer searches over families and the
+    best one wins (bytegrad scores higher in this synthetic)."""
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=30,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0,
+        tune_algorithm=True,
+    )
+    server = make_server(0, service)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    client = AutotuneClient("127.0.0.1", port)
+    client.wait_until_ready(10)
+    decls = [t.model_dump() for t in tensor_list(n=8, numel=1000)]
+    rsp = client.register_tensors("ma", decls)
+    hp = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
+    for it in range(1, 40):
+        score = 100.0 + (50.0 if hp.algorithm == "bytegrad" else 0.0)
+        client.report_metrics("ma", 0, it, hp.model_dump(), score)
+        rsp = client.ask_hyperparameters("ma", 0, it)
+        hp = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
+        if rsp["is_autotune_completed"]:
+            break
+    assert rsp["is_autotune_completed"]
+    assert hp.algorithm == "bytegrad"
+    server.shutdown()
+
+
+def test_trainer_switches_algorithm():
+    """Trainer swaps gradient_allreduce -> bytegrad on recommendation and
+    keeps training (state layout unchanged)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.models.mlp import MLP
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    model = MLP(features=(16, 8))
+    mesh = build_mesh({"dp": 8})
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+    y = jnp.argmax(x @ jax.random.normal(jax.random.PRNGKey(1), (4, 8)), -1)
+    params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
+
+    def loss_fn(p, batch):
+        import optax as _o
+        logits = model.apply({"params": p}, batch["x"])
+        return _o.softmax_cross_entropy_with_integer_labels(logits, batch["y"]).mean()
+
+    trainer = BaguaTrainer(loss_fn, optax.sgd(0.1), GradientAllReduceAlgorithm(),
+                           mesh=mesh, autotune=False)
+    state = trainer.init(params)
+    state, l0 = trainer.train_step(state, {"x": x, "y": y})
+    trainer._apply_recommendation(BaguaHyperparameter(algorithm="bytegrad"))
+    assert trainer.algorithm.name == "bytegrad"
+    losses = []
+    for _ in range(10):
+        state, loss = trainer.train_step(state, {"x": x, "y": y})
+        losses.append(float(loss))
+    assert losses[-1] < float(l0)
+
+
 def test_autotune_level_zero_is_passthrough(service_client):
     service, client = service_client
     service.autotune_level = 0
