@@ -75,11 +75,19 @@ class BaguaTrainer:
         donate: bool = True,
         expert_axis: Optional[str] = None,
         expert_keyword: str = "expert",
+        seq_axis: Optional[str] = None,
     ):
         """``expert_axis``: mesh axis carrying expert parallelism (MoE).
         Params whose name contains ``expert_keyword`` are sharded over it and
         excluded from the data-parallel bucket plan (reference
-        ``param.expert`` flags, moe/experts.py:26-29 + distributed.py:66)."""
+        ``param.expert`` flags, moe/experts.py:26-29 + distributed.py:66).
+
+        ``seq_axis``: mesh axis carrying sequence/context parallelism (ring
+        attention / Ulysses).  The batch is replicated over it (each shard
+        slices its own sequence chunk, see ``sp_lm_loss_fn``) while gradient
+        communication spans it: each shard's grads cover only its chunk's
+        contribution, so dp-style averaging over dp × sp restores the full
+        gradient."""
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.algorithm = algorithm
@@ -98,23 +106,28 @@ class BaguaTrainer:
             expert_axis if expert_axis and expert_axis in mesh.axis_names else None
         )
         self.expert_keyword = expert_keyword
+        self.seq_axis = seq_axis if seq_axis and seq_axis in mesh.axis_names else None
         if dp_axes is None:
             dp_axes = tuple(
                 a for a in mesh.axis_names
-                if a in ("dp", "inter", "intra") and a != self.expert_axis
+                if a in ("dp", "inter", "intra")
+                and a not in (self.expert_axis, self.seq_axis)
             )
-            if not dp_axes and self.expert_axis is None:
+            if not dp_axes and self.expert_axis is None and self.seq_axis is None:
                 dp_axes = (mesh.axis_names[0],)
         self.dp_axes = tuple(dp_axes)
-        if self.expert_axis is not None and not algorithm.replicated_params:
+        if (
+            self.expert_axis is not None or self.seq_axis is not None
+        ) and not algorithm.replicated_params:
             raise NotImplementedError(
-                "expert parallelism with gossip (per-rank-weight) algorithms "
-                "is not supported yet"
+                "expert/sequence parallelism with gossip (per-rank-weight) "
+                "algorithms is not supported yet"
             )
         # the batch is sharded over dp AND ep, so dense-grad comm spans both;
-        # expert grads are only averaged over dp (experts differ across ep)
-        self.comm_axes = self.dp_axes + (
-            (self.expert_axis,) if self.expert_axis else ()
+        # expert grads are only averaged over dp (experts differ across ep);
+        # sp shards contribute partial grads, so comm spans sp too
+        self.comm_axes = self.dp_axes + tuple(
+            a for a in (self.expert_axis, self.seq_axis) if a is not None
         )
         self.world_size = mesh_axis_size(mesh, self.comm_axes)
         self.bucket_bytes = bucket_bytes or env.get_default_bucket_size()
@@ -256,7 +269,12 @@ class BaguaTrainer:
         # per-shard state is stacked (leading rank axis) for gossip
         # algorithms and for expert parallelism
         stacked = (not replicated) or expert is not None
-        expert_dp = tuple(a for a in dp if mesh.shape[a] > 1)
+        # expert grads average over dp (+sp: partial-sequence contributions)
+        # but never over ep, where experts differ
+        expert_dp = tuple(
+            a for a in dp + ((self.seq_axis,) if self.seq_axis else ())
+            if mesh.shape[a] > 1
+        )
 
         def per_shard(state: TrainState, batch):
             params = state.params
@@ -271,12 +289,22 @@ class BaguaTrainer:
 
             loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
             grads, algo_state = algo.process_grads(ctx, grads, params, algo_state, step)
-            if expert is not None and expert_dp:
-                # expert grads bypass the bucket plan; they are replicated
-                # over dp only (each ep shard owns different experts)
+            if expert is not None:
+                # Expert grads bypass the bucket plan.  The all_to_all
+                # backward already SUMS every ep shard's loss contribution
+                # into the owning shard's expert grad, while each shard's
+                # loss is a local mean — so the global-mean gradient needs a
+                # 1/ep_size rescale, then averaging over the dp(+sp) axes
+                # where experts are replicated.
+                ep_size = mesh.shape[expert]
+
+                def expert_grad(g):
+                    g = g / ep_size
+                    return jax.lax.pmean(g, expert_dp) if expert_dp else g
+
                 grads = jax.tree_util.tree_map_with_path(
                     lambda path, g: (
-                        jax.lax.pmean(g, expert_dp)
+                        expert_grad(g)
                         if self._is_expert_name(_name_of_path(path)) else g
                     ),
                     grads,
@@ -406,10 +434,12 @@ class BaguaTrainer:
         self.algorithm = SWITCHABLE_ALGORITHMS[target](
             bool(recommended.is_hierarchical_reduce)
         )
-        # rebuild the plan: bucket alignment differs between families
-        # (ByteGrad pads buckets to the world size)
-        self.rebucket([[t.declaration() for t in b.tensors]
-                       for b in self._plan.buckets])
+        if not recommended.buckets:
+            # rebuild the plan under the new family's alignment (ByteGrad
+            # pads buckets to the world size); skipped when the caller is
+            # about to apply the recommendation's own buckets anyway
+            self.rebucket([[t.declaration() for t in b.tensors]
+                           for b in self._plan.buckets])
 
     def _autotune_step(self, state):
         from ..communication import get_hyperparameters_service_client

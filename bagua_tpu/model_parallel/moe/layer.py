@@ -24,16 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ...parallel.mesh import axis_bound as _axis_bound
 from .gating import top1_gating, top2_gating
-
-
-def _axis_bound(name: str) -> bool:
-    """True when ``name`` is a live mesh axis (i.e. we're inside shard_map)."""
-    try:
-        lax.axis_index(name)
-        return True
-    except NameError:
-        return False
 
 
 class MoEMLP(nn.Module):

@@ -23,6 +23,9 @@ import jax
 import jax.numpy as jnp
 
 
+from ..parallel.mesh import axis_bound as _axis_bound
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 32000
@@ -34,6 +37,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    #: sequence-parallel mesh axis: when set and bound (inside shard_map),
+    #: each shard holds a contiguous sequence chunk and position embeddings
+    #: are offset by axis_index * local_len
+    sp_axis: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -149,7 +156,12 @@ class TransformerLM(nn.Module):
             "pos_embed", nn.initializers.normal(0.02),
             (cfg.max_seq_len, cfg.d_model), cfg.param_dtype,
         )
-        x = x + pos[None, : tokens.shape[1]].astype(cfg.dtype)
+        s = tokens.shape[1]
+        start = 0
+        if cfg.sp_axis is not None and _axis_bound(cfg.sp_axis):
+            start = jax.lax.axis_index(cfg.sp_axis) * s
+        pos_slice = jax.lax.dynamic_slice_in_dim(pos, start, s, axis=0)
+        x = x + pos_slice[None].astype(cfg.dtype)
         block_cls = nn.checkpoint(Block) if cfg.remat else Block
         for i in range(cfg.n_layers):
             mlp = self.mlp_factory(i) if self.mlp_factory is not None else None
@@ -172,6 +184,34 @@ def lm_loss_fn(model: TransformerLM):
         logits = model.apply({"params": params}, tokens[:, :-1])
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, tokens[:, 1:]
+        ).mean()
+
+    return loss_fn
+
+
+def sp_lm_loss_fn(model: TransformerLM, sp_size: int, sp_axis: str = "sp"):
+    """Sequence-parallel next-token loss.
+
+    ``batch['tokens']`` is the FULL [batch, seq_global+1] array, replicated
+    over the sp axis; each shard slices its contiguous chunk, runs the model
+    on local positions, and computes the loss for its targets.  The trainer's
+    loss allreduce (over dp × sp) averages the shard means, which equals the
+    global mean because chunks are equal-sized.
+    """
+
+    def loss_fn(params, batch):
+        import optax
+
+        tokens = batch["tokens"]
+        seq_global = tokens.shape[1] - 1
+        assert seq_global % sp_size == 0, (seq_global, sp_size)
+        s_local = seq_global // sp_size
+        start = jax.lax.axis_index(sp_axis) * s_local
+        inputs = jax.lax.dynamic_slice_in_dim(tokens, start, s_local, axis=1)
+        targets = jax.lax.dynamic_slice_in_dim(tokens, start + 1, s_local, axis=1)
+        logits = model.apply({"params": params}, inputs)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
         ).mean()
 
     return loss_fn

@@ -5,3 +5,5 @@ from .mesh import (  # noqa: F401
     mesh_axis_size,
     set_global_mesh,
 )
+from .ring_attention import make_ring_attention  # noqa: F401
+from .ulysses import make_ulysses_attention  # noqa: F401

@@ -104,6 +104,19 @@ def get_global_mesh_if_set() -> Optional[Mesh]:
     return _GLOBAL_MESH
 
 
+def axis_bound(name: str) -> bool:
+    """True when ``name`` is a live mesh axis, i.e. the caller is tracing
+    inside ``shard_map`` over a mesh containing it.  Modules use this to
+    degrade to a local computation during ``model.init`` outside the mesh."""
+    import jax
+
+    try:
+        jax.lax.axis_index(name)
+        return True
+    except NameError:
+        return False
+
+
 def mesh_axis_size(mesh: Mesh, axes) -> int:
     if isinstance(axes, str):
         axes = (axes,)

@@ -1,0 +1,82 @@
+"""Ring attention: causal flash-style attention over a sequence-parallel axis.
+
+Absent from the reference (SURVEY.md §5.7 — its closest primitives are the
+MoE all-to-all and ``alltoall_v``); first-class here because long-context is a
+framework requirement.  Design is the TPU-native ring form (Liu et al.,
+arXiv 2310.01889): the sequence is sharded over the ``'sp'`` mesh axis, each
+step combines the resident K/V block with a numerically-stable online-softmax
+update while ``lax.ppermute`` rotates K/V one hop around the ring — the
+rotation rides ICI concurrently with the block matmuls, which is exactly the
+compute/comm overlap the reference's Rust scheduler provided for DP, applied
+to attention.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def make_ring_attention(sp_size: int, axis_name: str = "sp"):
+    """Build an ``attn_fn(q, k, v, dtype)`` for ``TransformerLM`` that runs
+    causal attention over a sequence sharded on ``axis_name``.
+
+    Inputs per shard: [batch, seq_local, heads, head_dim] where shard i holds
+    global positions [i*seq_local, (i+1)*seq_local).  Must run inside
+    shard_map over a mesh containing ``axis_name`` (of size ``sp_size``).
+    """
+
+    def attn_fn(q, k, v, dtype):
+        b, s, h, d = q.shape
+        scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+        from .mesh import axis_bound
+
+        if not axis_bound(axis_name):
+            # outside shard_map (e.g. model.init): plain local attention —
+            # shapes and params are identical, only used for tracing
+            from ..models.transformer import causal_attention
+
+            return causal_attention(q, k, v, dtype)
+        my = lax.axis_index(axis_name)
+        q32 = q.astype(jnp.float32)
+        q_pos = my * s + jnp.arange(s)
+
+        # ring neighbor: receive from the previous rank so that after t hops
+        # we hold the K/V block originated by shard (my - t) mod sp
+        perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
+
+        def body(t, carry):
+            o, m, l, k_blk, v_blk = carry
+            src = (my - t) % sp_size
+            k_pos = src * s + jnp.arange(s)
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+            ) * scale
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
+
+            m_new = jnp.maximum(m, logits.max(axis=-1))
+            corr = jnp.exp(m - m_new)
+            p = jnp.exp(logits - m_new[..., None])
+            # fully-masked blocks contribute nothing (exp(NEG_INF - m) == 0)
+            l_new = l * corr + p.sum(axis=-1)
+            pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+            o_new = o * corr[..., None] + pv
+
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            return o_new, m_new, l_new, k_blk, v_blk
+
+        o0 = jnp.zeros((b, h, s, d), jnp.float32)
+        m0 = jnp.full((b, h, s), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, s), jnp.float32)
+        o, m, l, _, _ = lax.fori_loop(0, sp_size, body, (o0, m0, l0, k, v))
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return out.transpose(0, 2, 1, 3).astype(dtype)  # [b, s, h, d]
+
+    return attn_fn

@@ -158,11 +158,16 @@ class AutotuneService:
             return self._reply(task)
         if now - task.first_ask_time < self.warmup_time_s:
             return self._reply(task)
-        # confidence gate: the current point must have run long enough
+        # confidence gate: the current point must have run long enough AND
+        # every rank must have checked in past the point's start iteration,
+        # so the summed speed reflects only the current config
         long_enough = (
             now - task.sample_start_time >= self.sampling_confidence_time_s
         )
-        if not (train_iter > task.sample_start_iter and long_enough):
+        all_ranks_in = len(task.iter_by_rank) >= self.world_size and all(
+            it > task.sample_start_iter for it in task.iter_by_rank.values()
+        )
+        if not (all_ranks_in and long_enough):
             return self._reply(task)
         score = sum(task.speed_by_rank.values())
         task.manager.record_sample(train_iter, task.recommended, score)

@@ -29,8 +29,11 @@ logger = logging.getLogger(__name__)
 MIN_BUCKET_SIZE_EXP = 10   # 1 KiB
 MAX_BUCKET_SIZE_EXP = 31   # 2 GiB   (reference: 2^10 .. 2^31)
 
-ALGORITHM_FAMILIES = ["gradient_allreduce", "bytegrad", "decentralized",
-                      "low_precision_decentralized", "qadam"]
+# Only families the trainer can hot-swap mid-training (stateless, replicated,
+# trainer-owned optimizer — see algorithms.SWITCHABLE_ALGORITHMS).  Gossip and
+# owner families change the TrainState layout, so recommending them would
+# record scores against configs the trainer silently cannot apply.
+ALGORITHM_FAMILIES = ["gradient_allreduce", "bytegrad"]
 
 
 class AutotuneTaskManager:

@@ -131,6 +131,51 @@ def test_moe_ep_matches_single_device_forward():
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
 
 
+def test_expert_grads_match_single_device():
+    """One SGD step with ep=4 must produce the SAME updated weights as the
+    single-device run (regression: expert grads were ep x too large because
+    the all_to_all backward already sums cross-shard contributions)."""
+    E, ep, d_model, d_ff, seq, b = 8, 4, 16, 32, 4, 8
+    lr = 0.1
+
+    single = MoEMLP(n_experts=E, d_ff=d_ff, ep_size=1, k=2,
+                    capacity_factor=float(E), dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, seq, d_model))
+    y = jax.random.normal(jax.random.PRNGKey(1), (b, seq, d_model))
+    params = single.init(jax.random.PRNGKey(2), x[:2])["params"]
+
+    def ref_loss(p, batch):
+        out = single.apply({"params": p}, batch["x"])
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    g = jax.grad(ref_loss)(params, {"x": x, "y": y})
+    ref_updated = jax.tree.map(lambda p_, g_: p_ - lr * g_, params, g)
+
+    sharded = MoEMLP(n_experts=E, d_ff=d_ff, ep_size=ep, k=2,
+                     capacity_factor=float(E), dtype=jnp.float32)
+
+    def sp_loss(p, batch):
+        out = sharded.apply({"params": p}, batch["x"])
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    mesh = build_mesh({"ep": ep}, jax.devices()[:ep])
+    trainer = BaguaTrainer(
+        sp_loss, optax.sgd(lr), GradientAllReduceAlgorithm(), mesh=mesh,
+        expert_axis="ep",
+    )
+    state = trainer.init(params)
+    state, _ = trainer.train_step(state, {"x": x, "y": y})
+    updated = trainer.unstack_params(state)
+    for (path, a), (_, r) in zip(
+        jax.tree_util.tree_flatten_with_path(updated)[0],
+        jax.tree_util.tree_flatten_with_path(ref_updated)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(r), atol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 def test_moe_expert_parallel_trains_e2e():
     """Full trainer path: mesh ('dp','ep'), experts sharded, loss decreases,
     experts stay distinct across ep shards."""
