@@ -1,0 +1,88 @@
+"""Checkpoint / resume.
+
+The reference has no framework-level checkpointing — its elastic example
+hand-rolls ``torch.save`` of ``{epoch, model_state_dict, optimizer_state_dict}``
+on rank 0 and reloads on (re)start
+(/root/reference/examples/elastic_training/main.py:238-259), relying on
+``_bagua_broadcast_parameters`` to re-sync.  On TPU the state is a sharded
+pytree, so this is a real subsystem here: orbax-backed, optionally async
+(saves overlap training), with retention pruning — the piece SURVEY.md §5.4
+calls out as required for the elastic workload.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Tuple
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+class BaguaCheckpointManager:
+    """Save/restore ``TrainState`` (or any pytree) with retention + async.
+
+    Thin policy layer over ``orbax.checkpoint.CheckpointManager``; all ranks
+    must call :meth:`save`/:meth:`restore` collectively (orbax coordinates
+    the multi-host barrier itself).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+        async_save: bool = True,
+    ):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = str(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any) -> bool:
+        """Queue a save (async by default); returns False when skipped by the
+        save-interval policy."""
+        return self._mgr.save(int(step), args=self._ocp.args.StandardSave(state))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Tuple[int, Any]:
+        """Restore the given (or latest) step.  ``state_like`` provides the
+        target pytree structure/shapes/shardings — pass a freshly-initialized
+        ``TrainState``; its buffers are replaced by the checkpoint values."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape") else x,
+            state_like,
+        )
+        restored = self._mgr.restore(
+            int(step), args=self._ocp.args.StandardRestore(abstract)
+        )
+        return int(step), restored
+
+    def try_restore(self, state_like: Any) -> Tuple[Optional[int], Any]:
+        """Restore latest if present, else return (None, state_like) —
+        the launcher's resume-on-restart entry point."""
+        if self.latest_step() is None:
+            return None, state_like
+        return self.restore(state_like)
+
+    def wait(self) -> None:
+        """Block until queued async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

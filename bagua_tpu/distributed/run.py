@@ -1,0 +1,182 @@
+"""Process launcher with gang restart.
+
+Counterpart of /root/reference/bagua/distributed/run.py (torchelastic wrapper:
+Bagua flags + env injection :360-398,578-600, elastic_launch with gang-restart
+semantics :116-129,603-628) and the legacy subprocess launcher ``launch.py``.
+
+TPU shape: one JAX process per host drives all local chips, so
+``--nproc_per_node`` defaults to 1 (it exists for CPU-simulation runs and
+hosts with multiple isolated accelerator sets).  Rendezvous is the JAX
+coordination service (``BAGUA_COORDINATOR_ADDR`` consumed by
+``bagua_tpu.init_process_group``) instead of a c10d store.  Elastic behavior
+is the honest XLA equivalent of torchelastic's: ANY worker failure kills the
+whole gang and restarts it (same world size) up to ``--max_restarts``, and
+workers resume from the latest checkpoint
+(:mod:`bagua_tpu.checkpoint`) — in-flight world-size *resizing* is impossible
+under XLA's static SPMD compilation, so MIN:MAX nnodes syntax is rejected
+rather than silently accepted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+logger = logging.getLogger("bagua_tpu.launcher")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        "python -m bagua_tpu.distributed.run",
+        description="bagua_tpu launcher (reference: bagua.distributed.run)",
+    )
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of nodes (fixed; MIN:MAX is rejected — XLA "
+                        "cannot resize in flight, restart with a new value)")
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="JAX processes per node (default 1: one process "
+                        "drives all local chips)")
+    p.add_argument("--master_addr", type=str, default="127.0.0.1")
+    p.add_argument("--master_port", type=int, default=29400)
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--monitor_interval", type=float, default=1.0)
+    # Bagua flags (reference run.py:360-398)
+    p.add_argument("--bagua_service_port", type=int, default=29500)
+    p.add_argument("--default_bucket_size", type=int, default=10 * 1024 ** 2)
+    p.add_argument("--autotune_level", type=int, default=0)
+    p.add_argument("--autotune_max_samples", type=int, default=60)
+    p.add_argument("--autotune_sampling_confidence_time", type=float, default=5.0)
+    p.add_argument("--autotune_warmup_time", type=float, default=30.0)
+    p.add_argument("--is_output_autotune_log", action="store_true")
+    p.add_argument("--autotune_algorithm", action="store_true",
+                   help="let the autotuner search over algorithm families")
+    p.add_argument("--simulate_cpu_devices", type=int, default=0,
+                   help="force JAX onto N virtual CPU devices (testing)")
+    p.add_argument("--no_python", action="store_true",
+                   help="run training_script directly instead of "
+                        "`python training_script`")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if ":" in args.nnodes:
+        p.error("elastic MIN:MAX nnodes is not supported on TPU — world size "
+                "is fixed per launch; restart the job to resize")
+    args.nnodes_int = int(args.nnodes)
+    return args
+
+
+def build_env(args, local_rank: int) -> dict:
+    """Reference ``set_bagua_env`` (run.py:578-600) + rendezvous env."""
+    env = dict(os.environ)
+    world_size = args.nnodes_int * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env.update(
+        RANK=str(rank),
+        WORLD_SIZE=str(world_size),
+        LOCAL_RANK=str(local_rank),
+        LOCAL_WORLD_SIZE=str(args.nproc_per_node),
+        NODE_RANK=str(args.node_rank),
+        MASTER_ADDR=args.master_addr,
+        MASTER_PORT=str(args.master_port),
+        BAGUA_SERVICE_PORT=str(args.bagua_service_port),
+        BAGUA_DEFAULT_BUCKET_SIZE=str(args.default_bucket_size),
+        BAGUA_AUTOTUNE=str(args.autotune_level),
+        BAGUA_AUTOTUNE_MAX_SAMPLES=str(args.autotune_max_samples),
+        BAGUA_AUTOTUNE_SAMPLING_CONFIDENCE_TIME_S=str(
+            args.autotune_sampling_confidence_time),
+        BAGUA_AUTOTUNE_WARMUP_TIME_S=str(args.autotune_warmup_time),
+        BAGUA_IS_OUTPUT_AUTOTUNE_LOG=str(int(args.is_output_autotune_log)),
+        BAGUA_AUTOTUNE_ALGORITHM=str(int(args.autotune_algorithm)),
+        AUTO_TUNE_SERVER_ADDR=f"{args.master_addr}:{args.bagua_service_port}",
+    )
+    if world_size > 1:
+        env["BAGUA_COORDINATOR_ADDR"] = f"{args.master_addr}:{args.master_port}"
+    if args.simulate_cpu_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_PLATFORM_NAME"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.simulate_cpu_devices}"
+        )
+    return env
+
+
+def spawn_gang(args) -> List[subprocess.Popen]:
+    cmd_prefix = [] if args.no_python else [sys.executable, "-u"]
+    procs = []
+    for local_rank in range(args.nproc_per_node):
+        cmd = cmd_prefix + [args.training_script] + args.training_script_args
+        procs.append(subprocess.Popen(cmd, env=build_env(args, local_rank)))
+    return procs
+
+
+def kill_gang(procs: List[subprocess.Popen]) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+
+
+def monitor(args, procs: List[subprocess.Popen]) -> int:
+    """Return exit code when all succeed; raise ``_GangFailure`` on any
+    worker failure (reference gang semantics run.py:116-129)."""
+    while True:
+        codes = [p.poll() for p in procs]
+        failed = [c for c in codes if c not in (None, 0)]
+        if failed:
+            kill_gang(procs)
+            raise _GangFailure(failed[0])
+        if all(c == 0 for c in codes):
+            return 0
+        time.sleep(args.monitor_interval)
+
+
+class _GangFailure(Exception):
+    def __init__(self, code: int):
+        super().__init__(f"worker failed with exit code {code}")
+        self.code = code
+
+
+def run(args) -> int:
+    attempt = 0
+    while True:
+        procs = spawn_gang(args)
+        try:
+            return monitor(args, procs)
+        except _GangFailure as f:
+            attempt += 1
+            if attempt > args.max_restarts:
+                logger.error(
+                    "worker failed (exit %d); max_restarts=%d exhausted",
+                    f.code, args.max_restarts,
+                )
+                return f.code
+            logger.warning(
+                "worker failed (exit %d); gang restart %d/%d",
+                f.code, attempt, args.max_restarts,
+            )
+        except KeyboardInterrupt:
+            kill_gang(procs)
+            return 130
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Elastic training example (reference examples/elastic_training/main.py):
+checkpoint every N steps, resume from the latest checkpoint on (re)start —
+the launcher's gang restart makes this the recovery path after any worker
+failure.
+
+Crash injection for tests: set BAGUA_TEST_CRASH_AT_STEP=k and the process
+exits(1) at step k on the FIRST attempt (a marker file suppresses repeats).
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bagua_tpu
+from bagua_tpu.algorithms import GradientAllReduceAlgorithm
+from bagua_tpu.checkpoint import BaguaCheckpointManager
+from bagua_tpu.models.mlp import MLP
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--save-every", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    mesh = bagua_tpu.init_process_group()
+    n_dev = len(jax.devices())
+    model = MLP(features=(32, 16, 8))
+    key = jax.random.PRNGKey(args.seed)
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = jax.random.normal(k1, (8 * n_dev, 16))
+    y = jnp.argmax(x @ jax.random.normal(k2, (16, 8)), axis=-1)
+    params = model.init(k3, x[:2])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    trainer = bagua_tpu.BaguaTrainer(
+        loss_fn, optax.sgd(0.1), GradientAllReduceAlgorithm(), mesh=mesh
+    )
+    state = trainer.init(params)
+
+    mgr = BaguaCheckpointManager(args.ckpt_dir, max_to_keep=2)
+    start_step, state = mgr.try_restore(state)
+    if start_step is not None:
+        print(f"resumed from checkpoint step {start_step}", flush=True)
+        start = start_step + 1
+    else:
+        start = 0
+
+    crash_at = int(os.environ.get("BAGUA_TEST_CRASH_AT_STEP", -1))
+    marker = os.path.join(args.ckpt_dir, "crashed.marker")
+
+    for step in range(start, args.steps):
+        if step == crash_at and not os.path.exists(marker):
+            open(marker, "w").close()
+            mgr.wait()
+            print("injected crash", flush=True)
+            sys.exit(1)
+        state, loss = trainer.train_step(state, {"x": x, "y": y})
+        if step % args.save_every == 0 or step == args.steps - 1:
+            mgr.save(step, state)
+        print(f"step {step} loss {float(loss):.6f}", flush=True)
+    mgr.close()
+    print(f"final_loss {float(loss):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

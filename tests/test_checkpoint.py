@@ -1,0 +1,92 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4: the TPU build needs a real
+orbax-style checkpoint subsystem; reference only hand-rolled torch.save)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.checkpoint import BaguaCheckpointManager
+from bagua_tpu.core.backend import BaguaTrainer
+from bagua_tpu.models.mlp import MLP
+from bagua_tpu.parallel.mesh import build_mesh
+
+N_DEVICES = 8
+
+
+def _setup():
+    model = MLP(features=(16, 8))
+    mesh = build_mesh({"dp": N_DEVICES})
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+    y = jnp.argmax(x @ jax.random.normal(jax.random.PRNGKey(1), (4, 8)), -1)
+    params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    def new_trainer():
+        return BaguaTrainer(loss_fn, optax.sgd(0.1),
+                            GradientAllReduceAlgorithm(), mesh=mesh)
+
+    return new_trainer, params, {"x": x, "y": y}
+
+
+def test_save_restore_resume_equals_uninterrupted(tmp_path):
+    new_trainer, params, batch = _setup()
+
+    # uninterrupted reference run: 6 steps
+    t0 = new_trainer()
+    s = t0.init(params)
+    ref_losses = []
+    for _ in range(6):
+        s, loss = t0.train_step(s, batch)
+        ref_losses.append(float(loss))
+
+    # interrupted run: 3 steps, save, "restart", restore, 3 more steps
+    t1 = new_trainer()
+    s1 = t1.init(params)
+    for _ in range(3):
+        s1, _ = t1.train_step(s1, batch)
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    assert mgr.save(3, s1)
+    mgr.wait()
+
+    t2 = new_trainer()
+    s2 = t2.init(params)  # fresh (wrong) state, then restored over
+    step, s2 = mgr.restore(s2)
+    assert step == 3
+    resumed = []
+    for _ in range(3):
+        s2, loss = t2.train_step(s2, batch)
+        resumed.append(float(loss))
+    np.testing.assert_allclose(resumed, ref_losses[3:], rtol=1e-6)
+    mgr.close()
+
+
+def test_retention_pruning(tmp_path):
+    new_trainer, params, batch = _setup()
+    t = new_trainer()
+    s = t.init(params)
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2,
+                                 async_save=False)
+    for step in range(5):
+        s, _ = t.train_step(s, batch)
+        mgr.save(step, s)
+    mgr.wait()
+    assert mgr.latest_step() == 4
+    assert len(mgr._mgr.all_steps()) <= 2
+    mgr.close()
+
+
+def test_try_restore_empty_dir(tmp_path):
+    new_trainer, params, _ = _setup()
+    t = new_trainer()
+    s = t.init(params)
+    mgr = BaguaCheckpointManager(str(tmp_path / "none"), async_save=False)
+    step, s2 = mgr.try_restore(s)
+    assert step is None and s2 is s
+    mgr.close()

@@ -1,0 +1,61 @@
+"""Launcher tests: env injection, gang restart, checkpoint resume
+(reference elastic semantics, run.py:116-129)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from bagua_tpu.distributed.run import build_env, parse_args
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_rejects_elastic_range():
+    with pytest.raises(SystemExit):
+        parse_args(["--nnodes", "1:4", "script.py"])
+
+
+def test_env_injection():
+    args = parse_args([
+        "--nnodes", "2", "--node_rank", "1", "--nproc_per_node", "2",
+        "--master_addr", "10.0.0.1", "--master_port", "12345",
+        "--bagua_service_port", "23456", "--autotune_level", "1",
+        "script.py", "--foo",
+    ])
+    env = build_env(args, local_rank=1)
+    assert env["RANK"] == "3"
+    assert env["WORLD_SIZE"] == "4"
+    assert env["LOCAL_RANK"] == "1"
+    assert env["MASTER_ADDR"] == "10.0.0.1"
+    assert env["BAGUA_COORDINATOR_ADDR"] == "10.0.0.1:12345"
+    assert env["BAGUA_AUTOTUNE"] == "1"
+    assert env["AUTO_TUNE_SERVER_ADDR"] == "10.0.0.1:23456"
+    assert args.training_script_args == ["--foo"]
+
+
+@pytest.mark.slow
+def test_gang_restart_resumes_from_checkpoint(tmp_path):
+    """Worker crashes at step 7; the launcher restarts the gang and the
+    example resumes from its checkpoint and finishes."""
+    ckpt = tmp_path / "ckpt"
+    env = dict(os.environ)
+    env["BAGUA_TEST_CRASH_AT_STEP"] = "7"
+    env.pop("BAGUA_SERVICE_PORT", None)
+    cmd = [
+        sys.executable, "-m", "bagua_tpu.distributed.run",
+        "--simulate_cpu_devices", "4",
+        "--bagua_service_port", "-1",
+        "--max_restarts", "2",
+        os.path.join(REPO, "examples", "elastic_training.py"),
+        "--ckpt-dir", str(ckpt), "--steps", "12", "--save-every", "2",
+    ]
+    out = subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=420
+    )
+    sys.stderr.write(out.stdout[-2000:] + out.stderr[-2000:])
+    assert out.returncode == 0
+    assert "injected crash" in out.stdout
+    assert "resumed from checkpoint step" in out.stdout
+    assert "final_loss" in out.stdout
