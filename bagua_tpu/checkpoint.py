@@ -57,16 +57,43 @@ class BaguaCheckpointManager:
     def restore(self, state_like: Any, step: Optional[int] = None) -> Tuple[int, Any]:
         """Restore the given (or latest) step.  ``state_like`` provides the
         target pytree structure/shapes/shardings — pass a freshly-initialized
-        ``TrainState``; its buffers are replaced by the checkpoint values."""
+        ``TrainState``; its buffers are replaced by the checkpoint values.
+
+        Shardings are rebuilt for the live mesh, not taken verbatim from
+        ``state_like``: leaves produced by the jitted step carry a
+        ``NamedSharding`` and keep it, but host-created leaves (the step
+        counter, replicated params fed straight into ``trainer.init``) only
+        carry a ``SingleDeviceSharding`` — restoring those as-is would commit
+        them to one device and the sharded train step would then reject the
+        state.  Any leaf without a ``NamedSharding`` is restored replicated
+        over the mesh harvested from its sibling leaves.
+        """
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
-            if hasattr(x, "shape") else x,
-            state_like,
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = None
+        for leaf in jax.tree.leaves(state_like):
+            s = getattr(leaf, "sharding", None)
+            if isinstance(s, NamedSharding):
+                mesh = s.mesh
+                break
+        replicated = (
+            NamedSharding(mesh, PartitionSpec()) if mesh is not None else None
         )
+
+        def abstract_leaf(x):
+            if not hasattr(x, "shape"):
+                return x
+            s = getattr(x, "sharding", None)
+            if not isinstance(s, NamedSharding):
+                s = replicated
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+
+        abstract = jax.tree.map(abstract_leaf, state_like)
         restored = self._mgr.restore(
             int(step), args=self._ocp.args.StandardRestore(abstract)
         )

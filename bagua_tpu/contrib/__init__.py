@@ -1,0 +1,25 @@
+"""Contrib layer: optimizer fusion, load balancing, sample caching, SyncBN.
+
+Counterpart of /root/reference/bagua/torch_api/contrib/ — every component the
+reference ships, rebuilt TPU-native (optax wrapper, torch-free samplers, flax
+SyncBatchNorm, stdlib TCP store standing in for redis).
+"""
+
+from .cache_loader import CacheLoader  # noqa: F401
+from .cached_dataset import CachedDataset  # noqa: F401
+from .fused_optimizer import FusedOptimizer, fuse_optimizer  # noqa: F401
+from .load_balancing_data_loader import (  # noqa: F401
+    LoadBalancingDistributedBatchSampler,
+    LoadBalancingDistributedSampler,
+)
+from .sync_batchnorm import SyncBatchNorm  # noqa: F401
+
+__all__ = [
+    "fuse_optimizer",
+    "FusedOptimizer",
+    "LoadBalancingDistributedSampler",
+    "LoadBalancingDistributedBatchSampler",
+    "CacheLoader",
+    "CachedDataset",
+    "SyncBatchNorm",
+]

@@ -1,0 +1,97 @@
+"""Fused optimizer: run the inner optimizer over per-dtype flat buffers.
+
+Counterpart of /root/reference/bagua/torch_api/contrib/fused_optimizer.py:8-134,
+which flattens parameters into contiguous storages so one optimizer step
+launches a few fused kernels instead of one per tensor.  Under XLA the
+*kernel* fusion is automatic inside ``jit``, so the TPU-native win is
+different but real: a model with thousands of small parameter leaves produces
+thousands of tiny HLO ops per optimizer state leaf — flattening them into one
+buffer per dtype shrinks the compiled program, speeds up compilation, and
+turns the update into a handful of large, MXU/VPU-friendly elementwise ops.
+
+Shape: an ``optax``-style wrapper, so it composes with the trainer the same
+way the reference composes with ``with_bagua`` (any
+``GradientTransformation`` can be fused)::
+
+    tx = fuse_optimizer(optax.adam(1e-3))
+    trainer = BaguaTrainer(loss_fn, tx, GradientAllReduceAlgorithm())
+
+Exact step-equality with the unfused optimizer holds for elementwise
+transforms (sgd, momentum, adam, adamw with uniform weight decay, ...) —
+the same caveat as the reference's storage flattening.  Transforms that
+inspect per-parameter shapes (e.g. factored second moments) change meaning
+when fused; don't wrap those.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["fuse_optimizer", "FusedOptimizer"]
+
+
+class _FusedState(NamedTuple):
+    inner: Any
+
+
+def _group_leaves(tree) -> Tuple[List[str], dict]:
+    """Leaves grouped by dtype name, in stable tree-flatten order."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    groups: dict = {}
+    for i, leaf in enumerate(leaves):
+        groups.setdefault(jnp.asarray(leaf).dtype.name, []).append(i)
+    return sorted(groups), groups
+
+
+def _flatten(tree) -> dict:
+    """Pytree -> {dtype_name: 1-D buffer} (concatenated raveled leaves)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    keys, groups = _group_leaves(tree)
+    return {
+        k: jnp.concatenate([jnp.ravel(leaves[i]) for i in groups[k]])
+        for k in keys
+    }
+
+
+def _unflatten(flat: dict, like) -> Any:
+    """{dtype_name: buffer} -> pytree with ``like``'s structure/shapes."""
+    leaves = jax.tree_util.tree_leaves(like)
+    treedef = jax.tree_util.tree_structure(like)
+    _, groups = _group_leaves(like)
+    out: List[Any] = [None] * len(leaves)
+    for k, idxs in groups.items():
+        buf, offset = flat[k], 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = jax.lax.dynamic_slice_in_dim(buf, offset, n).reshape(
+                leaves[i].shape
+            )
+            offset += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def fuse_optimizer(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Wrap ``inner`` to run over per-dtype flattened buffers."""
+
+    def init_fn(params):
+        return _FusedState(inner.init(_flatten(params)))
+
+    def update_fn(updates, state, params=None):
+        flat_updates = _flatten(updates)
+        flat_params = _flatten(params) if params is not None else None
+        flat_out, inner_state = inner.update(
+            flat_updates, state.inner, flat_params
+        )
+        return _unflatten(flat_out, updates), _FusedState(inner_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# reference-compatible name
+FusedOptimizer = fuse_optimizer

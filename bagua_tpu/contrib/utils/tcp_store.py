@@ -1,0 +1,208 @@
+"""Spawnable cross-process KV store over TCP (stdlib only).
+
+Role counterpart of the reference's ``RedisStore``
+(/root/reference/bagua/torch_api/contrib/utils/redis_store.py:38+), which
+spawns ``redis-server`` processes per node and bootstraps a hash-sharded
+cluster view.  This environment has no redis, and a TPU pod's host network is
+plain TCP anyway, so the native equivalent is a small threaded socket server:
+each host can spawn one (or connect to existing ones), and a
+:class:`~bagua_tpu.contrib.utils.store.ClusterStore` over the clients gives
+the same sharded shared-cache semantics.
+
+Wire protocol: length-prefixed pickle request/response per connection
+(requests: (op, args...) tuples) — values are opaque bytes, mirroring redis
+GET/SET/MSET/MGET/DBSIZE/FLUSHDB/PING/SHUTDOWN.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple, Union
+
+from .store import ClusterStore, Store
+
+__all__ = ["TCPStoreServer", "TCPStore", "TCPClusterStore", "start_tcp_store"]
+
+Value = Union[str, bytes]
+_LEN = struct.Struct("!I")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("tcp store connection closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        data: Dict[str, Value] = self.server.data  # type: ignore[attr-defined]
+        lock: threading.Lock = self.server.data_lock  # type: ignore[attr-defined]
+        try:
+            while True:
+                op, *args = _recv_msg(self.request)
+                if op == "set":
+                    with lock:
+                        data[args[0]] = args[1]
+                    reply = True
+                elif op == "get":
+                    with lock:
+                        reply = data.get(args[0])
+                elif op == "mset":
+                    with lock:
+                        data.update(args[0])
+                    reply = True
+                elif op == "mget":
+                    with lock:
+                        reply = [data.get(k) for k in args[0]]
+                elif op == "num_keys":
+                    with lock:
+                        reply = len(data)
+                elif op == "clear":
+                    with lock:
+                        data.clear()
+                    reply = True
+                elif op == "ping":
+                    reply = "pong"
+                elif op == "shutdown":
+                    _send_msg(self.request, True)
+                    threading.Thread(
+                        target=self.server.shutdown, daemon=True
+                    ).start()
+                    return
+                else:
+                    reply = RuntimeError(f"unknown op {op!r}")
+                _send_msg(self.request, reply)
+        except (ConnectionError, OSError):
+            return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    # class attrs take effect before bind (instance assignment after
+    # bind_and_activate=True would be a no-op)
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class TCPStoreServer:
+    """A threaded KV server bound to (host, port); port 0 = auto-pick."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = _Server((host, port), _Handler, bind_and_activate=True)
+        self._server.data = {}  # type: ignore[attr-defined]
+        self._server.data_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address[:2]
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TCPStore(Store):
+    """Client for one :class:`TCPStoreServer` (one connection, lock-guarded)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        self.host, self.port = host, int(port)
+        self._sock = socket.create_connection((host, int(port)), timeout=timeout_s)
+        self._lock = threading.Lock()
+        self._alive = True
+
+    def _call(self, op: str, *args):
+        with self._lock:
+            _send_msg(self._sock, (op, *args))
+            reply = _recv_msg(self._sock)
+        if isinstance(reply, Exception):
+            raise reply
+        return reply
+
+    def set(self, key: str, value: Value) -> None:
+        self._call("set", key, value)
+
+    def get(self, key: str) -> Optional[Value]:
+        return self._call("get", key)
+
+    def mset(self, dictionary: Dict[str, Value]) -> None:
+        self._call("mset", dict(dictionary))
+
+    def mget(self, keys: List[str]) -> List[Optional[Value]]:
+        return self._call("mget", list(keys))
+
+    def num_keys(self) -> int:
+        return self._call("num_keys")
+
+    def clear(self) -> None:
+        self._call("clear")
+
+    def status(self) -> bool:
+        try:
+            return self._call("ping") == "pong"
+        except (ConnectionError, OSError):
+            return False
+
+    def shutdown(self) -> None:
+        """Ask the server to exit (for servers this client manages)."""
+        try:
+            self._call("shutdown")
+        except (ConnectionError, OSError):
+            pass
+        try:
+            self._sock.close()
+        finally:
+            self._alive = False
+
+
+class TCPClusterStore(ClusterStore):
+    """Hash-sharded view over several TCP stores.
+
+    ``hosts``: list of ``{"host": ..., "port": ...}`` dicts (same bootstrap
+    shape the reference's RedisStore takes).  When ``hosts`` is None, spawns
+    ``num_shards`` in-process servers (the single-host convenience path).
+    """
+
+    def __init__(self, hosts=None, num_shards: int = 1):
+        self._servers: List[TCPStoreServer] = []
+        if hosts is None:
+            for _ in range(max(1, num_shards)):
+                self._servers.append(TCPStoreServer())
+            hosts = [
+                {"host": s.address[0], "port": s.address[1]}
+                for s in self._servers
+            ]
+        clients = [TCPStore(h["host"], int(h["port"])) for h in hosts]
+        super().__init__(clients)
+
+    def shutdown(self) -> None:
+        if self._servers:  # only kill servers we spawned
+            super().shutdown()
+            for s in self._servers:
+                s.stop()
+            self._servers = []
+
+
+def start_tcp_store(host: str = "127.0.0.1", port: int = 0) -> TCPStoreServer:
+    """Spawn a store server and return it (its ``.address`` is connectable)."""
+    return TCPStoreServer(host, port)

@@ -14,7 +14,10 @@ whole gang and restarts it (same world size) up to ``--max_restarts``, and
 workers resume from the latest checkpoint
 (:mod:`bagua_tpu.checkpoint`) — in-flight world-size *resizing* is impossible
 under XLA's static SPMD compilation, so MIN:MAX nnodes syntax is rejected
-rather than silently accepted.
+rather than silently accepted.  Gang restart is **single-node only**: this
+launcher monitors its own subprocesses, so with ``--nnodes > 1`` restarts
+must come from the cluster manager re-launching every node together
+(``--max_restarts > 0`` is rejected there rather than silently node-local).
 """
 
 from __future__ import annotations
@@ -45,7 +48,9 @@ def parse_args(argv=None):
                         "drives all local chips)")
     p.add_argument("--master_addr", type=str, default="127.0.0.1")
     p.add_argument("--master_port", type=int, default=29400)
-    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--max_restarts", type=int, default=None,
+                   help="gang restarts after a worker failure (default 3; "
+                        "single-node only — multi-node defaults to 0)")
     p.add_argument("--monitor_interval", type=float, default=1.0)
     # Bagua flags (reference run.py:360-398)
     p.add_argument("--bagua_service_port", type=int, default=29500)
@@ -69,6 +74,17 @@ def parse_args(argv=None):
         p.error("elastic MIN:MAX nnodes is not supported on TPU — world size "
                 "is fixed per launch; restart the job to resize")
     args.nnodes_int = int(args.nnodes)
+    if args.nnodes_int > 1 and (args.max_restarts or 0) > 0:
+        # Gang restart is node-local: this launcher only monitors its own
+        # node's workers, so restarting them after a remote failure would
+        # leave survivors hung in collectives and the restarted workers
+        # unable to rejoin the JAX coordination service.  Multi-node
+        # restart must come from the cluster manager re-launching every node.
+        p.error("gang restart (--max_restarts > 0) only supports single-node "
+                "launches; with --nnodes > 1 the cluster manager must "
+                "restart all nodes together")
+    if args.max_restarts is None:
+        args.max_restarts = 3 if args.nnodes_int == 1 else 0
     return args
 
 
@@ -95,6 +111,18 @@ def build_env(args, local_rank: int) -> dict:
         BAGUA_IS_OUTPUT_AUTOTUNE_LOG=str(int(args.is_output_autotune_log)),
         BAGUA_AUTOTUNE_ALGORITHM=str(int(args.autotune_algorithm)),
         AUTO_TUNE_SERVER_ADDR=f"{args.master_addr}:{args.bagua_service_port}",
+    )
+    # Workers must inherit the launcher's import environment: the spawned
+    # `python training_script` has the *script's* directory as sys.path[0],
+    # so an un-installed bagua_tpu (or the user's own modules in cwd) would
+    # not be importable.  torchelastic effectively does the same.
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    extra_paths = [os.getcwd(), pkg_parent]
+    prev = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = os.pathsep.join(
+        dict.fromkeys(p for p in extra_paths + prev.split(os.pathsep) if p)
     )
     if world_size > 1:
         env["BAGUA_COORDINATOR_ADDR"] = f"{args.master_addr}:{args.master_port}"

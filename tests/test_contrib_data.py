@@ -1,0 +1,234 @@
+"""Load-balancing sampler + cache loader/dataset tests (reference
+tests/contrib/test_load_balancing_data_loader.py and test_cached_dataset.py
+patterns: partition/coverage invariants, balance of per-step complexity,
+epoch determinism, cache hit behavior)."""
+
+import numpy as np
+import pytest
+
+from bagua_tpu.contrib import (
+    CachedDataset,
+    CacheLoader,
+    LoadBalancingDistributedBatchSampler,
+    LoadBalancingDistributedSampler,
+)
+
+N = 97
+WORLD = 4
+
+
+class CountingDataset:
+    """Indexable dataset that counts raw accesses."""
+
+    def __init__(self, n):
+        self.n = n
+        self.accesses = 0
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        self.accesses += 1
+        return np.full((4,), i, dtype=np.int32)
+
+
+def _complexities(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 1000, n).tolist()
+
+
+def _make_samplers(n=N, world=WORLD, **kw):
+    data = _complexities(n)
+    return data, [
+        LoadBalancingDistributedSampler(
+            data, complexity_fn=lambda x: x, num_replicas=world, rank=r, **kw
+        )
+        for r in range(world)
+    ]
+
+
+def test_partition_and_coverage():
+    data, samplers = _make_samplers()
+    per_rank = [list(s) for s in samplers]
+    lens = {len(ix) for ix in per_rank}
+    assert lens == {samplers[0].num_samples}
+    # every index appears; wrap-padding may duplicate a few
+    seen = set()
+    for ix in per_rank:
+        seen.update(ix)
+    assert seen == set(range(N))
+
+
+def test_step_complexity_is_balanced():
+    """Rank-to-rank complexity spread per step stays within the chunk
+    neighborhood (samples in one chunk are complexity-adjacent).  Uses a
+    world-divisible dataset size: the wrap-padded final chunk of an uneven
+    split legitimately mixes the list's two ends."""
+    data, samplers = _make_samplers(n=96)
+    per_rank = [list(s) for s in samplers]
+    sorted_cx = sorted(data)
+    # worst adjacent-window spread over the sorted complexities
+    max_window = max(
+        sorted_cx[i + WORLD - 1] - sorted_cx[i]
+        for i in range(len(sorted_cx) - WORLD + 1)
+    )
+    for step in range(len(per_rank[0])):
+        step_cx = [data[per_rank[r][step]] for r in range(WORLD)]
+        assert max(step_cx) - min(step_cx) <= max_window
+
+
+def test_epoch_determinism_and_reshuffle():
+    _, samplers = _make_samplers()
+    s = samplers[0]
+    s.set_epoch(0)
+    first = list(s)
+    s.set_epoch(0)
+    assert list(s) == first
+    s.set_epoch(1)
+    assert list(s) != first
+
+
+def test_ranks_agree_on_chunks():
+    """All ranks see the same chunk decomposition (required so rank r can
+    take element r of each chunk without coordination)."""
+    _, samplers = _make_samplers()
+    for s in samplers:
+        s.set_epoch(3)
+    chunks = [s.shuffle_chunks() for s in samplers]
+    for other in chunks[1:]:
+        assert other[0] == chunks[0][0]
+        assert other[1] == chunks[0][1]
+
+
+def test_drop_last_and_validation():
+    data = _complexities(10)
+    s = LoadBalancingDistributedSampler(
+        data, lambda x: x, num_replicas=4, rank=0, drop_last=True
+    )
+    assert len(list(s)) == s.num_samples == 2
+    with pytest.raises(ValueError):
+        LoadBalancingDistributedSampler(
+            data, lambda x: x, num_replicas=4, rank=4
+        )
+    with pytest.raises(ValueError):
+        LoadBalancingDistributedSampler(
+            data, lambda x: x, num_replicas=4, rank=0, random_level=1.5
+        )
+
+
+def test_batch_sampler_same_batch_count_across_ranks():
+    data = _complexities(N)
+
+    def batch_fn(indices):
+        # token-budget packing: complexity sum per batch <= 1500
+        batches, cur, budget = [], [], 0
+        for i in indices:
+            if cur and budget + data[i] > 1500:
+                batches.append(cur)
+                cur, budget = [], 0
+            cur.append(i)
+            budget += data[i]
+        if cur:
+            batches.append(cur)
+        return batches
+
+    batch_samplers = [
+        LoadBalancingDistributedBatchSampler(
+            LoadBalancingDistributedSampler(
+                data, lambda x: x, num_replicas=WORLD, rank=r
+            ),
+            batch_fn=batch_fn,
+        )
+        for r in range(WORLD)
+    ]
+    counts = {len(list(bs)) for bs in batch_samplers}
+    assert len(counts) == 1
+    bs = batch_samplers[0]
+    bs.set_epoch(1)
+    assert len(bs) > 0
+
+
+def test_batch_sampler_cycle_pads_small_ranks():
+    """A rank with fewer than half the max batch count must cycle its own
+    batches until every rank yields the same number (an under-padded rank
+    would desync the SPMD step count and hang a collective)."""
+    data = _complexities(32)
+
+    def batch_fn_skewed(indices):
+        # rank-dependent batch count: tiny batches for high-complexity ranks
+        if sum(data[i] for i in indices) > np.median(
+            [data[i] for i in range(32)]
+        ) * len(indices):
+            return [[i] for i in indices]          # many batches
+        return [list(indices)]                     # one big batch
+
+    samplers = [
+        LoadBalancingDistributedBatchSampler(
+            LoadBalancingDistributedSampler(
+                data, lambda x: x, num_replicas=2, rank=r, shuffle=False
+            ),
+            batch_fn=batch_fn_skewed,
+        )
+        for r in range(2)
+    ]
+    counts = [len(list(bs)) for bs in samplers]
+    assert counts[0] == counts[1] == samplers[0].total_batch
+
+
+def test_cache_loader_hit_miss():
+    loader = CacheLoader(backend="memory", dataset_name="t", writer_buffer_size=1)
+    calls = []
+
+    def load_fn(k):
+        calls.append(k)
+        return {"k": k}
+
+    assert loader.get(5, load_fn) == {"k": 5}
+    assert loader.get(5, load_fn) == {"k": 5}
+    assert calls == [5]
+    assert loader.num_keys() == 1
+
+
+def test_cache_loader_write_batching_visible_before_flush():
+    # buffer of 10: first 9 writes stay pending but must still be readable
+    loader = CacheLoader(backend="memory", dataset_name="t", writer_buffer_size=10)
+    calls = []
+
+    def load_fn(k):
+        calls.append(k)
+        return k * 2
+
+    for k in range(9):
+        loader.get(k, load_fn)
+    assert loader.num_keys() == 0  # nothing flushed yet
+    for k in range(9):  # re-reads hit the pending write map, not load_fn
+        assert loader.get(k, load_fn) == k * 2
+    assert calls == list(range(9))
+    loader.get(9, load_fn)  # 10th write triggers the flush
+    assert loader.num_keys() == 10
+
+
+def test_cached_dataset():
+    ds = CountingDataset(20)
+    cached = CachedDataset(ds, backend="memory", dataset_name="cd",
+                           writer_buffer_size=1)
+    assert len(cached) == 20
+    a = cached[3]
+    b = cached[3]
+    np.testing.assert_array_equal(a, b)
+    assert ds.accesses == 1
+    _ = [cached[i] for i in range(20)]
+    assert ds.accesses == 20
+    _ = [cached[i] for i in range(20)]
+    assert ds.accesses == 20  # all hits
+
+
+def test_cached_dataset_tcp_backend():
+    ds = CountingDataset(8)
+    cached = CachedDataset(ds, backend="tcp", dataset_name="cdt",
+                           writer_buffer_size=1, num_shards=2)
+    _ = [cached[i] for i in range(8)]
+    _ = [cached[i] for i in range(8)]
+    assert ds.accesses == 8
+    assert cached.cache_loader.num_keys() == 8
+    cached.cache_loader.store.shutdown()

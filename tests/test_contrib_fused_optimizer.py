@@ -1,0 +1,90 @@
+"""Fused optimizer tests (reference tests/contrib/test_fused_optimizer.py
+pattern: fused-vs-plain optimizer step equality, here through the real
+BaguaTrainer on the 8-device mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.contrib import fuse_optimizer
+from bagua_tpu.core.backend import BaguaTrainer
+from bagua_tpu.models.mlp import MLP
+from bagua_tpu.parallel.mesh import build_mesh
+
+N_DEVICES = 8
+
+
+def _tree_allclose(a, b, **kw):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), **kw
+        ),
+        a, b,
+    )
+
+
+def test_flatten_roundtrip():
+    from bagua_tpu.contrib.fused_optimizer import _flatten, _unflatten
+
+    tree = {
+        "a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+        "b": {"c": jnp.ones((4,), jnp.bfloat16), "d": jnp.zeros((2, 2), jnp.float32)},
+    }
+    flat = _flatten(tree)
+    assert set(flat) == {"float32", "bfloat16"}
+    assert flat["float32"].shape == (10,)
+    _tree_allclose(_unflatten(flat, tree), tree)
+
+
+def test_fused_equals_plain_adam():
+    params = {
+        "w1": jnp.linspace(-1, 1, 12).reshape(3, 4),
+        "b1": jnp.zeros((4,)),
+        "w2": jnp.linspace(0.5, -0.5, 8).reshape(4, 2),
+    }
+    grads = jax.tree.map(lambda p: jnp.cos(p) * 0.1, params)
+
+    plain = optax.adam(1e-2)
+    fused = fuse_optimizer(optax.adam(1e-2))
+    ps, fs = plain.init(params), fused.init(params)
+    p_plain, p_fused = params, params
+    for _ in range(5):
+        u, ps = plain.update(grads, ps, p_plain)
+        p_plain = optax.apply_updates(p_plain, u)
+        u, fs = fused.update(grads, fs, p_fused)
+        p_fused = optax.apply_updates(p_fused, u)
+    _tree_allclose(p_plain, p_fused, rtol=1e-6)
+
+
+def test_fused_trainer_equals_plain_trainer():
+    model = MLP(features=(16, 8))
+    mesh = build_mesh({"dp": N_DEVICES})
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+    y = jnp.argmax(x @ jax.random.normal(jax.random.PRNGKey(1), (4, 8)), -1)
+    params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
+    batch = {"x": x, "y": y}
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    losses = {}
+    finals = {}
+    for name, tx in [
+        ("plain", optax.sgd(0.1, momentum=0.9)),
+        ("fused", fuse_optimizer(optax.sgd(0.1, momentum=0.9))),
+    ]:
+        t = BaguaTrainer(loss_fn, tx, GradientAllReduceAlgorithm(), mesh=mesh)
+        s = t.init(params)
+        ls = []
+        for _ in range(4):
+            s, loss = t.train_step(s, batch)
+            ls.append(float(loss))
+        losses[name] = ls
+        finals[name] = s.params
+    np.testing.assert_allclose(losses["plain"], losses["fused"], rtol=1e-6)
+    _tree_allclose(finals["plain"], finals["fused"], rtol=1e-5, atol=1e-6)
