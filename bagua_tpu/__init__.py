@@ -21,6 +21,7 @@ from .communication import (  # noqa: F401
     allreduce_inplace,
     alltoall,
     alltoall_inplace,
+    alltoall_v,
     barrier,
     broadcast,
     gather,

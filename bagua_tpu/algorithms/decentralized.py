@@ -57,6 +57,7 @@ class DecentralizedAlgorithm(Algorithm):
         hierarchical: bool = True,
         peer_selection_mode: str = "all",
         communication_interval: int = 1,
+        track_peer_weights: bool = False,
     ):
         """
         Args:
@@ -66,11 +67,21 @@ class DecentralizedAlgorithm(Algorithm):
                 ``"shift_one"`` (rotating pairwise exchange).
             communication_interval: Iterations between communications
                 (reference decentralized.py:34-36).
+            track_peer_weights: keep the post-communication weights in the
+                algorithm state (the analog of the reference's ``peer_weight``
+                bucket tensor, bucket.py:197-263) — lets tests assert the
+                exact peer-equality invariant at the communication point.
         """
         assert peer_selection_mode in ("all", "shift_one"), peer_selection_mode
         self.hierarchical = hierarchical
         self.peer_selection_mode = peer_selection_mode
         self.communication_interval = communication_interval
+        self.track_peer_weights = track_peer_weights
+
+    def init_state(self, ctx: AlgorithmContext, params) -> Any:
+        if not self.track_peer_weights:
+            return None
+        return {"peer_weights": ctx.plan.flatten_tree(params)}
 
     def _exchange(self, ctx: AlgorithmContext, flat, step):
         use_hier = (
@@ -102,14 +113,30 @@ class DecentralizedAlgorithm(Algorithm):
             return [self._exchange(ctx, f, step) for f in fs]
 
         if self.communication_interval > 1:
-            flats = lax.cond(
+            # non-communication steps must KEEP the previously tracked
+            # peer weights, not overwrite them with local weights
+            prev_peer = (
+                algo_state["peer_weights"] if self.track_peer_weights else flats
+            )
+
+            def comm_branch(op):
+                fs, _ = op
+                out = do_comm(fs)
+                return out, out
+
+            def skip_branch(op):
+                fs, prev = op
+                return fs, prev
+
+            flats, peer = lax.cond(
                 step % self.communication_interval == 0,
-                do_comm,
-                lambda fs: fs,
-                flats,
+                comm_branch, skip_branch, (flats, prev_peer),
             )
         else:
             flats = do_comm(flats)
+            peer = flats
+        if self.track_peer_weights:
+            algo_state = {"peer_weights": peer}
         return ctx.plan.unflatten_tree(flats, params), algo_state
 
 

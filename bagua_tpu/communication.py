@@ -140,6 +140,23 @@ class BaguaCommunicator:
         return lax.all_to_all(x, self.axes[0], split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
 
+    def alltoall_v(
+        self, x, output, input_offsets, send_sizes, output_offsets, recv_sizes
+    ):
+        """Ragged all-to-all (reference ``alltoall_v``,
+        communicators/mod.rs:632-676): rank r sends
+        ``x[input_offsets[i] : input_offsets[i]+send_sizes[i]]`` to each rank
+        i, which lands at ``output_offsets`` in that rank's ``output`` buffer
+        (which supplies capacity, dtype, and the values of untouched slots).
+        Lowers to XLA's native ragged-all-to-all over ICI.
+        """
+        if len(self.axes) != 1:
+            raise ValueError("alltoall_v needs a single mesh axis")
+        return lax.ragged_all_to_all(
+            x, output, input_offsets, send_sizes, output_offsets, recv_sizes,
+            axis_name=self.axes[0],
+        )
+
     def ppermute(self, x, perm: Sequence[Tuple[int, int]]):
         if len(self.axes) != 1:
             raise ValueError("ppermute needs a single mesh axis")
@@ -305,22 +322,40 @@ def init_process_group(
 # ---------------------------------------------------------------------------
 
 
-def _eager(comm: Optional[BaguaCommunicator], fn, *arrays):
+# compiled eager primitives, keyed on (mesh, axes, op signature, arg avals):
+# re-tracing `jit(shard_map(...))` on every standalone-collective call would
+# make the reference's synchronous primitive API pay a trace+dispatch cost
+# per invocation
+_EAGER_CACHE: dict = {}
+
+
+def _eager(comm: Optional[BaguaCommunicator], key, fn, *arrays):
     """Run ``fn`` once per rank: inputs' leading axis is the rank axis; inside
-    ``fn`` each rank sees its own tensor (leading axis stripped)."""
+    ``fn`` each rank sees its own tensor (leading axis stripped).  ``key``
+    identifies the operation (name + static params) for the compile cache."""
     comm = comm if comm is not None else get_backend("").global_communicator
     mesh = comm.mesh
-    spec = P(comm.axis_name if len(comm.axes) == 1 else comm.axes)
-
-    def wrapped(*blocks):
-        out = fn(*[b[0] for b in blocks])
-        return jax.tree.map(lambda o: jnp.expand_dims(o, 0), out)
-
-    f = shard_map(
-        wrapped, mesh=mesh, in_specs=tuple(spec for _ in arrays), out_specs=spec,
-        check_vma=False,
+    arrays = tuple(jnp.asarray(a) for a in arrays)
+    cache_key = (
+        mesh, comm.axes, key,
+        tuple((a.shape, a.dtype.name) for a in arrays),
     )
-    return jax.jit(f)(*arrays)
+    compiled = _EAGER_CACHE.get(cache_key)
+    if compiled is None:
+        spec = P(comm.axis_name if len(comm.axes) == 1 else comm.axes)
+
+        def wrapped(*blocks):
+            out = fn(*[b[0] for b in blocks])
+            return jax.tree.map(lambda o: jnp.expand_dims(o, 0), out)
+
+        compiled = jax.jit(
+            shard_map(
+                wrapped, mesh=mesh, in_specs=tuple(spec for _ in arrays),
+                out_specs=spec, check_vma=False,
+            )
+        )
+        _EAGER_CACHE[cache_key] = compiled
+    return compiled(*arrays)
 
 
 def _comm_or_default(comm):
@@ -331,7 +366,7 @@ def allreduce(send, op: ReduceOp = ReduceOp.AVG, comm: Optional[BaguaCommunicato
     """Reduce across the rank axis; every rank slice gets the result
     (reference communication.py:427-495)."""
     c = _comm_or_default(comm)
-    return _eager(comm, lambda x: c.allreduce(x, op), send)
+    return _eager(comm, ("allreduce", int(op)), lambda x: c.allreduce(x, op), send)
 
 
 def allreduce_inplace(tensor, op: ReduceOp = ReduceOp.AVG, comm=None):
@@ -342,7 +377,8 @@ def allgather(send, comm: Optional[BaguaCommunicator] = None):
     """Each rank slice becomes the concatenation of all slices
     (reference communication.py:498-560)."""
     c = _comm_or_default(comm)
-    return _eager(comm, lambda x: c.allgather(x, axis=0, tiled=True), send)
+    return _eager(comm, ("allgather",),
+                  lambda x: c.allgather(x, axis=0, tiled=True), send)
 
 
 allgather_inplace = allgather
@@ -350,7 +386,8 @@ allgather_inplace = allgather
 
 def reduce_scatter(send, op: ReduceOp = ReduceOp.SUM, comm=None):
     c = _comm_or_default(comm)
-    return _eager(comm, lambda x: c.reduce_scatter(x, op, axis=0), send)
+    return _eager(comm, ("reduce_scatter", int(op)),
+                  lambda x: c.reduce_scatter(x, op, axis=0), send)
 
 
 reduce_scatter_inplace = reduce_scatter
@@ -358,15 +395,98 @@ reduce_scatter_inplace = reduce_scatter
 
 def alltoall(send, comm=None):
     c = _comm_or_default(comm)
-    return _eager(comm, lambda x: c.alltoall_tiled(x, 0, 0), send)
+    return _eager(comm, ("alltoall",), lambda x: c.alltoall_tiled(x, 0, 0), send)
 
 
 alltoall_inplace = alltoall
 
 
+def alltoall_v(send, send_counts, output_size: Optional[int] = None, comm=None):
+    """Ragged all-to-all (reference ``alltoall_v``,
+    communicators/mod.rs:632-676).
+
+    ``send``: ``[nranks, L, ...]`` — each rank slice packs its outgoing chunks
+    consecutively (chunk for rank 0 first).  ``send_counts``: static
+    ``[nranks, nranks]`` matrix (Python/numpy ints); ``send_counts[r][d]`` =
+    elements rank r sends to rank d.  Returns ``[nranks, output_size, ...]``
+    where each rank slice packs the chunks received from rank 0, 1, ...
+    consecutively, zero-padded to ``output_size`` (default: the max total
+    receive count — XLA needs one static shape across ranks).
+    """
+    import numpy as np
+
+    c = _comm_or_default(comm)
+    counts = np.asarray(send_counts, dtype=np.int64)
+    n = c.nranks()
+    if counts.shape != (n, n):
+        raise ValueError(f"send_counts must be [{n},{n}], got {counts.shape}")
+    recv_counts = counts.T  # recv_counts[d][s] = what d receives from s
+    need = int(recv_counts.sum(axis=1).max())
+    out_size = need if output_size is None else int(output_size)
+    if out_size < need:
+        raise ValueError(f"output_size {out_size} < max receive total {need}")
+    # static per-rank offset tables, gathered inside the traced fn by rank
+    input_offsets = np.concatenate(
+        [np.zeros((n, 1), np.int64), np.cumsum(counts, axis=1)[:, :-1]], axis=1
+    )
+    recv_offsets = np.concatenate(
+        [np.zeros((n, 1), np.int64), np.cumsum(recv_counts, axis=1)[:, :-1]],
+        axis=1,
+    )
+    # output_offsets[r][d]: where rank r's chunk lands in rank d's output
+    output_offsets = recv_offsets.T.copy()
+
+    # XLA's native ragged-all-to-all exists on TPU; elsewhere (the CPU test
+    # mesh) fall back to a padded dense all_to_all + masked scatter with
+    # identical semantics.
+    native = c.mesh.devices.flat[0].platform == "tpu"
+    key = ("alltoall_v", native, counts.tobytes(), out_size)
+
+    def fn_native(x):
+        r = c.rank()
+        sel = lambda table: jnp.asarray(table)[r]
+        output = jnp.zeros((out_size,) + x.shape[1:], x.dtype)
+        return c.alltoall_v(
+            x, output, sel(input_offsets), sel(counts),
+            sel(output_offsets), sel(recv_counts.copy()),
+        )
+
+    maxc = max(1, int(counts.max()))
+
+    def fn_padded(x):
+        r = c.rank()
+        sel = lambda table: jnp.asarray(table)[r]
+        my_counts, my_in_off = sel(counts), sel(input_offsets)
+        my_recv_counts, my_recv_off = sel(recv_counts.copy()), sel(recv_offsets)
+        # pack chunk for each destination into a padded [n, maxc, ...] buffer
+        xp = jnp.concatenate(
+            [x, jnp.zeros((maxc,) + x.shape[1:], x.dtype)], axis=0
+        )
+        idx = my_in_off[:, None] + jnp.arange(maxc)[None, :]        # [n, maxc]
+        valid_out = jnp.arange(maxc)[None, :] < my_counts[:, None]
+        padded = jnp.where(
+            valid_out.reshape(n, maxc, *([1] * (x.ndim - 1))),
+            xp[idx], 0,
+        )
+        got = c.alltoall(padded)                                    # [n, maxc, ...]
+        # recompose: element j of chunk-from-s lands at recv_off[s]+j,
+        # padding lands in a dump slot past the end
+        valid_in = jnp.arange(maxc)[None, :] < my_recv_counts[:, None]
+        tgt = jnp.where(
+            valid_in, my_recv_off[:, None] + jnp.arange(maxc)[None, :], out_size
+        )
+        out = jnp.zeros((out_size + 1,) + x.shape[1:], x.dtype)
+        out = out.at[tgt.reshape(-1)].set(
+            got.reshape((n * maxc,) + x.shape[1:])
+        )
+        return out[:out_size]
+
+    return _eager(comm, key, fn_native if native else fn_padded, send)
+
+
 def broadcast(tensor, src: int = 0, comm=None):
     c = _comm_or_default(comm)
-    return _eager(comm, lambda x: c.broadcast(x, src), tensor)
+    return _eager(comm, ("broadcast", src), lambda x: c.broadcast(x, src), tensor)
 
 
 def reduce(send, dst: int, op: ReduceOp = ReduceOp.SUM, comm=None):
@@ -378,7 +498,7 @@ def reduce(send, dst: int, op: ReduceOp = ReduceOp.SUM, comm=None):
         red = c.allreduce(x, op)
         return jnp.where(c.rank() == dst, red, x)
 
-    return _eager(comm, fn, send)
+    return _eager(comm, ("reduce", dst, int(op)), fn, send)
 
 
 def gather(send, dst: int, comm=None):
@@ -390,7 +510,7 @@ def gather(send, dst: int, comm=None):
         mine = jnp.concatenate([x] * n, axis=0)
         return jnp.where(c.rank() == dst, g, mine)
 
-    return _eager(comm, fn, send)
+    return _eager(comm, ("gather", dst), fn, send)
 
 
 def scatter(send, src: int, comm=None):
@@ -404,18 +524,21 @@ def scatter(send, src: int, comm=None):
         chunks = full.reshape((n, -1) + full.shape[1:])
         return jnp.squeeze(lax.dynamic_slice_in_dim(chunks, c.rank(), 1, axis=0), 0)
 
-    return _eager(comm, fn, send)
+    return _eager(comm, ("scatter", src), fn, send)
 
 
 def send_recv(send, peer_perm: List[Tuple[int, int]], comm=None):
     """Point-to-point exchange expressed as a permutation (reference send/recv
     communication.py:233-267 — on TPU p2p is ``ppermute`` over ICI)."""
     c = _comm_or_default(comm)
-    return _eager(comm, lambda x: c.ppermute(x, peer_perm), send)
+    perm = tuple((int(a), int(b)) for a, b in peer_perm)
+    return _eager(comm, ("send_recv", perm), lambda x: c.ppermute(x, perm), send)
 
 
 def barrier(comm=None):
     c = _comm_or_default(comm)
     n = c.nranks()
-    out = _eager(comm, lambda x: c.barrier() * jnp.ones((1,), jnp.int32), jnp.zeros((n, 1), jnp.int32))
+    out = _eager(comm, ("barrier",),
+                 lambda x: c.barrier() * jnp.ones((1,), jnp.int32),
+                 jnp.zeros((n, 1), jnp.int32))
     jax.block_until_ready(out)

@@ -66,6 +66,25 @@ def decompress_chunked(mn: jax.Array, mx: jax.Array, payload: jax.Array) -> jax.
     return vals.reshape(-1)
 
 
+def _codec(comm: BaguaCommunicator):
+    """Pick the codec implementation: the fused Pallas kernels on TPU
+    (single HBM pass, see :mod:`.pallas_codec`), plain jnp elsewhere.
+    ``BAGUA_DISABLE_PALLAS_CODEC=1`` forces the jnp path for A/B checks."""
+    import os
+
+    on_tpu = comm.mesh.devices.flat[0].platform == "tpu"
+    if on_tpu and os.environ.get("BAGUA_DISABLE_PALLAS_CODEC") != "1":
+        from .pallas_codec import (
+            compress_chunked_pallas, decompress_chunked_pallas,
+        )
+
+        return (
+            lambda v, n: compress_chunked_pallas(v, n),
+            lambda mn, mx, p: decompress_chunked_pallas(mn, mx, p),
+        )
+    return compress_chunked, decompress_chunked
+
+
 def compressed_scatter_gather_allreduce(
     comm: BaguaCommunicator, x: jax.Array, average: bool = True
 ) -> jax.Array:
@@ -78,16 +97,17 @@ def compressed_scatter_gather_allreduce(
     ``size % nranks == 0`` (the bucket layer pads with world-size alignment).
     """
     n = comm.nranks()
-    mn, mx, payload = compress_chunked(x, n)
+    compress, decompress = _codec(comm)
+    mn, mx, payload = compress(x, n)
     # each rank ends up with every rank's chunk r (r = own rank index)
     payload_t = comm.alltoall(payload, split_axis=0, concat_axis=0)
     mn_t = comm.alltoall(mn, split_axis=0, concat_axis=0)
     mx_t = comm.alltoall(mx, split_axis=0, concat_axis=0)
-    vals = decompress_chunked(mn_t, mx_t, payload_t).reshape(n, -1)
+    vals = decompress(mn_t, mx_t, payload_t).reshape(n, -1)
     red = vals.mean(axis=0) if average else vals.sum(axis=0)
     # compress own reduced chunk and share it with everyone
-    mn2, mx2, payload2 = compress_chunked(red, 1)
+    mn2, mx2, payload2 = compress(red, 1)
     payload_all = comm.allgather(payload2, axis=0, tiled=True)  # [n, chunk]
     mn_all = comm.allgather(mn2, axis=0, tiled=True)            # [n]
     mx_all = comm.allgather(mx2, axis=0, tiled=True)
-    return decompress_chunked(mn_all, mx_all, payload_all).astype(x.dtype)
+    return decompress(mn_all, mx_all, payload_all).astype(x.dtype)

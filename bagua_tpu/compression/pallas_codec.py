@@ -1,0 +1,144 @@
+"""Pallas TPU kernels for the MinMaxUInt8 chunked codec.
+
+The perf-critical piece for ByteGrad/QAdam parity (SURVEY.md §7.5): the
+reference fuses this on GPU as CUB DeviceReduce min/max + a quantize kernel
+(/root/reference/rust/bagua-core/bagua-core-internal/kernels/bagua_kernels.cu:269-572)
+— two passes over HBM.  Plain-XLA ``compress_chunked`` also lowers to two
+passes (a reduce then an elementwise map).  These kernels do it in ONE: each
+grid step pulls its chunk into VMEM once, computes the masked min/max on the
+VPU, quantizes in-register, and writes only the u8 payload + two scalars back
+to HBM — halving the codec's HBM traffic, which is what bounds it (the math
+is trivially elementwise).
+
+Layout matches :mod:`.minmax_uint8` (same quantization formula, same
+``(mn, mx, payload)`` triple), so the two implementations are drop-in
+interchangeable and golden-tested against each other.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+EPS = 1e-7
+LEVELS = 255.0
+
+_LANE = 128
+_U8_SUBLANE = 32  # min u8 tile is (32, 128)
+
+
+def _padded_rows(chunk: int) -> int:
+    rows = -(-chunk // _LANE)
+    return -(-rows // _U8_SUBLANE) * _U8_SUBLANE
+
+
+# Scalars can't be standalone (1,1) TPU outputs (min tile is (8,128)), so
+# min/max travel in one (8,128) f32 "stats" block per chunk: row 0 = mn,
+# row 1 = mx (lane 0).  16 KiB per chunk of stats — noise next to the payload.
+_STATS_ROWS = 8
+
+
+def _compress_kernel(x_ref, stats_ref, payload_ref, *, chunk: int):
+    x = x_ref[:].astype(jnp.float32)
+    rows, lanes = x.shape
+    flat_idx = (
+        jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) * lanes
+        + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    )
+    mask = flat_idx < chunk
+    mn = jnp.min(jnp.where(mask, x, jnp.inf))
+    mx = jnp.max(jnp.where(mask, x, -jnp.inf))
+    scale = LEVELS / (mx - mn + EPS)
+    upper = jnp.round(mx * scale)
+    lower = upper - LEVELS
+    level = jnp.clip(jnp.round(x * scale), lower, upper)
+    row = jax.lax.broadcasted_iota(jnp.int32, (_STATS_ROWS, _LANE), 0)
+    stats_ref[:] = jnp.where(row == 0, mn, mx)
+    # Mosaic has no direct f32<->u8 cast; hop through i32
+    payload_ref[:] = (level - lower).astype(jnp.int32).astype(jnp.uint8)
+
+
+def _decompress_kernel(stats_ref, payload_ref, out_ref):
+    mn = stats_ref[0, 0]
+    mx = stats_ref[1, 0]
+    scale = LEVELS / (mx - mn + EPS)
+    upper = jnp.round(mx * scale)
+    lower = upper - LEVELS
+    vals = payload_ref[:].astype(jnp.int32).astype(jnp.float32)
+    out_ref[:] = (vals + lower) / scale
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def compress_chunked_pallas(
+    x: jax.Array, n_chunks: int, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused per-chunk min/max + quantize; same contract as
+    :func:`bagua_tpu.compression.compress_chunked`."""
+    assert x.size % n_chunks == 0, (x.size, n_chunks)
+    chunk = x.size // n_chunks
+    rows = _padded_rows(chunk)
+    padded = rows * _LANE
+    xp = jnp.pad(
+        x.reshape(n_chunks, chunk).astype(jnp.float32),
+        ((0, 0), (0, padded - chunk)),
+    ).reshape(n_chunks * rows, _LANE)
+
+    stats, payload = pl.pallas_call(
+        functools.partial(_compress_kernel, chunk=chunk),
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((_STATS_ROWS, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_chunks * _STATS_ROWS, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((n_chunks * rows, _LANE), jnp.uint8),
+        ],
+        interpret=interpret,
+    )(xp)
+    payload = payload.reshape(n_chunks, padded)[:, :chunk]
+    stats = stats.reshape(n_chunks, _STATS_ROWS, _LANE)
+    return stats[:, 0, 0], stats[:, 1, 0], payload
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def decompress_chunked_pallas(
+    mn: jax.Array, mx: jax.Array, payload: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Inverse of :func:`compress_chunked_pallas`; returns flat f32."""
+    n_chunks, chunk = payload.shape
+    rows = _padded_rows(chunk)
+    padded = rows * _LANE
+    pp = jnp.pad(payload, ((0, 0), (0, padded - chunk))).reshape(
+        n_chunks * rows, _LANE
+    )
+    # lay out as [n_chunks*_STATS_ROWS, _LANE] with [0,0]=mn, [1,0]=mx
+    block = jnp.zeros((n_chunks, _STATS_ROWS, _LANE), jnp.float32)
+    block = block.at[:, 0, 0].set(mn.astype(jnp.float32))
+    block = block.at[:, 1, 0].set(mx.astype(jnp.float32))
+    out = pl.pallas_call(
+        _decompress_kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((_STATS_ROWS, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_chunks * rows, _LANE), jnp.float32),
+        interpret=interpret,
+    )(block.reshape(n_chunks * _STATS_ROWS, _LANE), pp)
+    return out.reshape(n_chunks, padded)[:, :chunk].reshape(-1)

@@ -1,0 +1,73 @@
+"""On-demand build of the native store server.
+
+The reference ships its native components prebuilt (setup.py downloads
+NCCL + bagua-net, builds the Rust core); this repo's only host-native
+runtime piece is small enough to compile at first use with the toolchain on
+the box.  The binary is cached next to the source keyed on a source hash, so
+rebuilds only happen when ``csrc/bagua_store_server.cpp`` changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))),
+    "csrc", "bagua_store_server.cpp",
+)
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    path = os.path.join(base, "bagua_tpu")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def ensure_store_server(required: bool = False) -> Optional[str]:
+    """Path to the compiled server binary, building it if needed.
+
+    Returns None (fallback to the Python server) when the source or a C++
+    compiler is unavailable — unless ``required``, which raises instead.
+    """
+    if not os.path.exists(_SRC):
+        if required:
+            raise FileNotFoundError(_SRC)
+        return None
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        if required:
+            raise RuntimeError("no C++ compiler found for the native store")
+        return None
+
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    binary = os.path.join(_cache_dir(), f"bagua_store_server-{digest}")
+    if os.path.exists(binary):
+        return binary
+
+    tmp = tempfile.mktemp(prefix="bagua_store_server-", dir=_cache_dir())
+    cmd = [cxx, "-O2", "-std=c++17", "-pthread", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        stderr = getattr(e, "stderr", b"") or b""
+        logger.warning("native store build failed: %s", stderr.decode()[-500:])
+        if required:
+            raise
+        return None
+    os.replace(tmp, binary)  # atomic vs concurrent builders
+    logger.info("built native store server -> %s", binary)
+    return binary

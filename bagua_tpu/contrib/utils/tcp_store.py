@@ -1,25 +1,32 @@
-"""Spawnable cross-process KV store over TCP (stdlib only).
+"""Spawnable cross-process KV store over TCP — Python client + two servers.
 
 Role counterpart of the reference's ``RedisStore``
 (/root/reference/bagua/torch_api/contrib/utils/redis_store.py:38+), which
-spawns ``redis-server`` processes per node and bootstraps a hash-sharded
-cluster view.  This environment has no redis, and a TPU pod's host network is
-plain TCP anyway, so the native equivalent is a small threaded socket server:
-each host can spawn one (or connect to existing ones), and a
-:class:`~bagua_tpu.contrib.utils.store.ClusterStore` over the clients gives
-the same sharded shared-cache semantics.
+spawns ``redis-server`` (a native C server) per node and shards a cluster
+view over them.  Here the native server is our own:
+``csrc/bagua_store_server.cpp`` (thread-per-connection C++; built on demand
+with g++ — see :mod:`.native_build`), with a stdlib-Python threaded server as
+the always-available fallback.  Both speak the same language-neutral binary
+protocol, so the client doesn't care which it reached.
 
-Wire protocol: length-prefixed pickle request/response per connection
-(requests: (op, args...) tuples) — values are opaque bytes, mirroring redis
-GET/SET/MSET/MGET/DBSIZE/FLUSHDB/PING/SHUTDOWN.
+Wire protocol (little-endian):
+    request:  u8 op | op-specific payload;  bytes fields are u32 len + raw
+    ops:      1=SET k v   2=GET k     3=MSET n (k v)*   4=MGET n k*
+              5=NUM_KEYS  6=CLEAR     7=PING            8=SHUTDOWN
+    response: GET   -> u8 present + [val]
+              MGET  -> u32 n + n * (u8 present + [val])
+              NUM_KEYS -> u64
+              others  -> u8 0 (ack)
+Values are opaque bytes (the cache layer pickles sample payloads itself,
+reference cache_loader.py serialize/deserialize).
 """
 
 from __future__ import annotations
 
-import pickle
 import socket
 import socketserver
 import struct
+import subprocess
 import threading
 from typing import Dict, List, Optional, Tuple, Union
 
@@ -28,12 +35,23 @@ from .store import ClusterStore, Store
 __all__ = ["TCPStoreServer", "TCPStore", "TCPClusterStore", "start_tcp_store"]
 
 Value = Union[str, bytes]
-_LEN = struct.Struct("!I")
+
+OP_SET, OP_GET, OP_MSET, OP_MGET, OP_NUM_KEYS, OP_CLEAR, OP_PING, OP_SHUTDOWN = (
+    range(1, 9)
+)
+
+_U8 = struct.Struct("<B")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+# sanity caps: a desynced or malicious client must not make the shared
+# server allocate gigabytes from one malformed length field
+_MAX_FRAME = 1 << 30   # 1 GiB per value
+_MAX_BATCH = 1 << 20   # keys per mset/mget
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+class _ProtocolError(ConnectionError):
+    pass
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -46,50 +64,91 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_msg(sock: socket.socket):
-    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+def _recv_bytes(sock: socket.socket) -> bytes:
+    (n,) = _U32.unpack(_recv_exact(sock, 4))
+    if n > _MAX_FRAME:
+        raise _ProtocolError(f"frame of {n} bytes exceeds cap {_MAX_FRAME}")
+    return _recv_exact(sock, n)
+
+
+def _recv_count(sock: socket.socket) -> int:
+    (n,) = _U32.unpack(_recv_exact(sock, 4))
+    if n > _MAX_BATCH:
+        raise _ProtocolError(f"batch of {n} items exceeds cap {_MAX_BATCH}")
+    return n
+
+
+def _pack_bytes(data: bytes) -> bytes:
+    return _U32.pack(len(data)) + data
+
+
+def _to_bytes(v: Value) -> bytes:
+    return v.encode() if isinstance(v, str) else bytes(v)
+
+
+# ---------------------------------------------------------------------------
+# Python fallback server
+# ---------------------------------------------------------------------------
 
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
-        data: Dict[str, Value] = self.server.data  # type: ignore[attr-defined]
+        data: Dict[bytes, bytes] = self.server.data  # type: ignore[attr-defined]
         lock: threading.Lock = self.server.data_lock  # type: ignore[attr-defined]
+        sock = self.request
         try:
             while True:
-                op, *args = _recv_msg(self.request)
-                if op == "set":
+                (op,) = _U8.unpack(_recv_exact(sock, 1))
+                if op == OP_SET:
+                    k, v = _recv_bytes(sock), _recv_bytes(sock)
                     with lock:
-                        data[args[0]] = args[1]
-                    reply = True
-                elif op == "get":
+                        data[k] = v
+                    sock.sendall(_U8.pack(0))
+                elif op == OP_GET:
+                    k = _recv_bytes(sock)
                     with lock:
-                        reply = data.get(args[0])
-                elif op == "mset":
+                        v = data.get(k)
+                    sock.sendall(
+                        _U8.pack(0) if v is None
+                        else _U8.pack(1) + _pack_bytes(v)
+                    )
+                elif op == OP_MSET:
+                    n = _recv_count(sock)
+                    items = [
+                        (_recv_bytes(sock), _recv_bytes(sock)) for _ in range(n)
+                    ]
                     with lock:
-                        data.update(args[0])
-                    reply = True
-                elif op == "mget":
+                        data.update(items)
+                    sock.sendall(_U8.pack(0))
+                elif op == OP_MGET:
+                    n = _recv_count(sock)
+                    keys = [_recv_bytes(sock) for _ in range(n)]
                     with lock:
-                        reply = [data.get(k) for k in args[0]]
-                elif op == "num_keys":
+                        vals = [data.get(k) for k in keys]
+                    out = [_U32.pack(n)]
+                    for v in vals:
+                        out.append(
+                            _U8.pack(0) if v is None
+                            else _U8.pack(1) + _pack_bytes(v)
+                        )
+                    sock.sendall(b"".join(out))
+                elif op == OP_NUM_KEYS:
                     with lock:
-                        reply = len(data)
-                elif op == "clear":
+                        sock.sendall(_U64.pack(len(data)))
+                elif op == OP_CLEAR:
                     with lock:
                         data.clear()
-                    reply = True
-                elif op == "ping":
-                    reply = "pong"
-                elif op == "shutdown":
-                    _send_msg(self.request, True)
+                    sock.sendall(_U8.pack(0))
+                elif op == OP_PING:
+                    sock.sendall(_U8.pack(0))
+                elif op == OP_SHUTDOWN:
+                    sock.sendall(_U8.pack(0))
                     threading.Thread(
                         target=self.server.shutdown, daemon=True
                     ).start()
                     return
                 else:
-                    reply = RuntimeError(f"unknown op {op!r}")
-                _send_msg(self.request, reply)
+                    return  # unknown op: drop the connection
         except (ConnectionError, OSError):
             return
 
@@ -102,77 +161,144 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 class TCPStoreServer:
-    """A threaded KV server bound to (host, port); port 0 = auto-pick."""
+    """A KV server on (host, port); port 0 = auto-pick.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``backend="auto"`` prefers the compiled C++ server (building it on first
+    use) and falls back to the in-process Python server; ``"python"`` /
+    ``"cpp"`` force one.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 backend: str = "auto"):
+        self._proc: Optional[subprocess.Popen] = None
+        self._server = None
+        self._addr: Tuple[str, int] = (host, port)
+        if backend in ("auto", "cpp"):
+            from .native_build import ensure_store_server
+
+            binary = ensure_store_server(required=(backend == "cpp"))
+            if binary is not None:
+                self._spawn_native(binary, host, port)
+                return
+        self._start_python(host, port)
+
+    def _start_python(self, host: str, port: int) -> None:
         self._server = _Server((host, port), _Handler, bind_and_activate=True)
         self._server.data = {}  # type: ignore[attr-defined]
         self._server.data_lock = threading.Lock()  # type: ignore[attr-defined]
-        self._thread = threading.Thread(
+        self._addr = self._server.server_address[:2]
+        threading.Thread(
             target=self._server.serve_forever, daemon=True
+        ).start()
+
+    def _spawn_native(self, binary: str, host: str, port: int) -> None:
+        # the server prints "LISTENING <port>\n" once bound
+        self._proc = subprocess.Popen(
+            [binary, host, str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         )
-        self._thread.start()
+        line = self._proc.stdout.readline()
+        if not line.startswith("LISTENING"):
+            raise RuntimeError(f"native store server failed to start: {line!r}")
+        self._addr = (host, int(line.split()[1]))
 
     @property
     def address(self) -> Tuple[str, int]:
-        return self._server.server_address[:2]
+        return self._addr
+
+    @property
+    def is_native(self) -> bool:
+        return self._proc is not None
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None
 
 
 class TCPStore(Store):
-    """Client for one :class:`TCPStoreServer` (one connection, lock-guarded)."""
+    """Client for one store server (one connection, lock-guarded)."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 30.0):
         self.host, self.port = host, int(port)
         self._sock = socket.create_connection((host, int(port)), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
-        self._alive = True
-
-    def _call(self, op: str, *args):
-        with self._lock:
-            _send_msg(self._sock, (op, *args))
-            reply = _recv_msg(self._sock)
-        if isinstance(reply, Exception):
-            raise reply
-        return reply
 
     def set(self, key: str, value: Value) -> None:
-        self._call("set", key, value)
+        msg = _U8.pack(OP_SET) + _pack_bytes(key.encode()) + _pack_bytes(
+            _to_bytes(value)
+        )
+        with self._lock:
+            self._sock.sendall(msg)
+            _recv_exact(self._sock, 1)
 
-    def get(self, key: str) -> Optional[Value]:
-        return self._call("get", key)
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            self._sock.sendall(_U8.pack(OP_GET) + _pack_bytes(key.encode()))
+            (present,) = _U8.unpack(_recv_exact(self._sock, 1))
+            return _recv_bytes(self._sock) if present else None
 
     def mset(self, dictionary: Dict[str, Value]) -> None:
-        self._call("mset", dict(dictionary))
+        parts = [_U8.pack(OP_MSET), _U32.pack(len(dictionary))]
+        for k, v in dictionary.items():
+            parts.append(_pack_bytes(k.encode()))
+            parts.append(_pack_bytes(_to_bytes(v)))
+        with self._lock:
+            self._sock.sendall(b"".join(parts))
+            _recv_exact(self._sock, 1)
 
-    def mget(self, keys: List[str]) -> List[Optional[Value]]:
-        return self._call("mget", list(keys))
+    def mget(self, keys: List[str]) -> List[Optional[bytes]]:
+        parts = [_U8.pack(OP_MGET), _U32.pack(len(keys))]
+        parts += [_pack_bytes(k.encode()) for k in keys]
+        with self._lock:
+            self._sock.sendall(b"".join(parts))
+            (n,) = _U32.unpack(_recv_exact(self._sock, 4))
+            out: List[Optional[bytes]] = []
+            for _ in range(n):
+                (present,) = _U8.unpack(_recv_exact(self._sock, 1))
+                out.append(_recv_bytes(self._sock) if present else None)
+            return out
 
     def num_keys(self) -> int:
-        return self._call("num_keys")
+        with self._lock:
+            self._sock.sendall(_U8.pack(OP_NUM_KEYS))
+            return _U64.unpack(_recv_exact(self._sock, 8))[0]
 
     def clear(self) -> None:
-        self._call("clear")
+        with self._lock:
+            self._sock.sendall(_U8.pack(OP_CLEAR))
+            _recv_exact(self._sock, 1)
 
     def status(self) -> bool:
         try:
-            return self._call("ping") == "pong"
+            with self._lock:
+                self._sock.sendall(_U8.pack(OP_PING))
+                _recv_exact(self._sock, 1)
+            return True
         except (ConnectionError, OSError):
             return False
 
     def shutdown(self) -> None:
         """Ask the server to exit (for servers this client manages)."""
         try:
-            self._call("shutdown")
+            with self._lock:
+                self._sock.sendall(_U8.pack(OP_SHUTDOWN))
+                _recv_exact(self._sock, 1)
         except (ConnectionError, OSError):
             pass
         try:
             self._sock.close()
-        finally:
-            self._alive = False
+        except OSError:
+            pass
 
 
 class TCPClusterStore(ClusterStore):
@@ -180,14 +306,14 @@ class TCPClusterStore(ClusterStore):
 
     ``hosts``: list of ``{"host": ..., "port": ...}`` dicts (same bootstrap
     shape the reference's RedisStore takes).  When ``hosts`` is None, spawns
-    ``num_shards`` in-process servers (the single-host convenience path).
+    ``num_shards`` local servers (the single-host convenience path).
     """
 
-    def __init__(self, hosts=None, num_shards: int = 1):
+    def __init__(self, hosts=None, num_shards: int = 1, backend: str = "auto"):
         self._servers: List[TCPStoreServer] = []
         if hosts is None:
             for _ in range(max(1, num_shards)):
-                self._servers.append(TCPStoreServer())
+                self._servers.append(TCPStoreServer(backend=backend))
             hosts = [
                 {"host": s.address[0], "port": s.address[1]}
                 for s in self._servers
@@ -203,6 +329,7 @@ class TCPClusterStore(ClusterStore):
             self._servers = []
 
 
-def start_tcp_store(host: str = "127.0.0.1", port: int = 0) -> TCPStoreServer:
+def start_tcp_store(host: str = "127.0.0.1", port: int = 0,
+                    backend: str = "auto") -> TCPStoreServer:
     """Spawn a store server and return it (its ``.address`` is connectable)."""
-    return TCPStoreServer(host, port)
+    return TCPStoreServer(host, port, backend=backend)

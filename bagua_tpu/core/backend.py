@@ -74,13 +74,19 @@ class BaguaTrainer:
         autotune: Optional[bool] = None,
         donate: bool = True,
         expert_axis: Optional[str] = None,
-        expert_keyword: str = "expert",
+        expert_params=None,
+        expert_keyword: Optional[str] = None,
         seq_axis: Optional[str] = None,
     ):
         """``expert_axis``: mesh axis carrying expert parallelism (MoE).
-        Params whose name contains ``expert_keyword`` are sharded over it and
-        excluded from the data-parallel bucket plan (reference
-        ``param.expert`` flags, moe/experts.py:26-29 + distributed.py:66).
+        Expert params are sharded over it and excluded from the data-parallel
+        bucket plan (reference ``param.expert`` flags, moe/experts.py:26-29 +
+        distributed.py:66).  Which params are experts is decided by
+        ``expert_params``: a ``name -> bool`` callable or an explicit
+        collection of param names; default = exact-name marking for params
+        created by :class:`bagua_tpu.model_parallel.moe.MoEMLP`.
+        ``expert_keyword`` (substring matching) is deprecated — it silently
+        captured any param whose name contained the keyword.
 
         ``seq_axis``: mesh axis carrying sequence/context parallelism (ring
         attention / Ulysses).  The batch is replicated over it (each shard
@@ -102,11 +108,17 @@ class BaguaTrainer:
                 else build_mesh()
             )
         self.mesh = mesh
-        self.expert_axis = (
-            expert_axis if expert_axis and expert_axis in mesh.axis_names else None
-        )
-        self.expert_keyword = expert_keyword
-        self.seq_axis = seq_axis if seq_axis and seq_axis in mesh.axis_names else None
+        # fail fast on typo'd axis names: silently nulling them would include
+        # expert params in the dense DP plan and corrupt MoE training
+        for label, ax in (("expert_axis", expert_axis), ("seq_axis", seq_axis)):
+            if ax is not None and ax not in mesh.axis_names:
+                raise ValueError(
+                    f"{label}={ax!r} is not a mesh axis "
+                    f"(mesh axes: {mesh.axis_names})"
+                )
+        self.expert_axis = expert_axis
+        self._expert_filter = self._make_expert_filter(expert_params, expert_keyword)
+        self.seq_axis = seq_axis
         if dp_axes is None:
             dp_axes = tuple(
                 a for a in mesh.axis_names
@@ -149,6 +161,12 @@ class BaguaTrainer:
         self._autotune_client = None
         self._autotune_failures = 0
         self._autotune_completed = not self.autotune
+        self._telemetry_reported = False
+
+        from ..watchdog import get_comm_timeout_s, get_global_watchdog
+
+        timeout = get_comm_timeout_s()
+        self._watchdog = get_global_watchdog(timeout) if timeout else None
         self._speed_tracker = StatisticalAverage()
         self._last_report_time = time.time()
         self._last_speed_time = time.time()
@@ -165,8 +183,30 @@ class BaguaTrainer:
             world_size=self.world_size,
         )
 
+    @staticmethod
+    def _make_expert_filter(expert_params, expert_keyword):
+        if expert_params is not None and expert_keyword is not None:
+            raise ValueError("pass expert_params OR expert_keyword, not both")
+        if expert_keyword is not None:
+            import warnings
+
+            warnings.warn(
+                "expert_keyword substring matching is deprecated; pass "
+                "expert_params (a name filter or collection of names)",
+                DeprecationWarning, stacklevel=3,
+            )
+            return lambda name: expert_keyword in name
+        if expert_params is None:
+            from ..model_parallel.moe.layer import is_expert_param
+
+            return is_expert_param
+        if callable(expert_params):
+            return expert_params
+        names = frozenset(expert_params)
+        return lambda name: name in names
+
     def _is_expert_name(self, name: str) -> bool:
-        return self.expert_axis is not None and self.expert_keyword in name
+        return self.expert_axis is not None and self._expert_filter(name)
 
     def _build_plan(self, params) -> BucketPlan:
         candidates = [
@@ -329,10 +369,9 @@ class BaguaTrainer:
 
         if expert is not None:
             pspec = P((expert,))
-            batch_spec = P(dp + (expert,))
         else:
             pspec = P() if replicated else P(dp)
-            batch_spec = P(dp)
+        batch_spec = self._batch_spec()
         state_specs = TrainState(step=P(), params=pspec, opt_state=pspec, algo_state=pspec)
 
         fn = shard_map(
@@ -371,8 +410,45 @@ class BaguaTrainer:
             and self._step_counter % 100 == 0
         ):
             self._autotune_step(state)
+        if (
+            self.autotune
+            and not self._autotune_completed
+            and not self._telemetry_reported
+            and env.get_autotune_level() >= 2
+        ):
+            self._report_tensor_execution_order(state, batch)
         fn = self._get_step_fn()
+        if self._watchdog is not None:
+            # synchronous under the watchdog: a cross-rank deadlock must
+            # surface as a stuck watched section, not an async no-op
+            with self._watchdog.watch(f"train_step[{self._step_counter}]"):
+                out = fn(state, batch)
+                jax.block_until_ready(out[1])
+            return out
         return fn(state, batch)
+
+    def _report_tensor_execution_order(self, state, batch) -> None:
+        """Feed the sidecar the observed gradient-readiness order (the
+        reference's OTel tensor_ready span pipeline,
+        bagua-opentelemetry/src/exporter/mod.rs:15-59): one-time, host-side,
+        off the hot path.  Enabled at BAGUA_AUTOTUNE >= 2 (profiling costs one
+        small compile per tensor)."""
+        self._telemetry_reported = True
+        try:
+            from ..communication import get_hyperparameters_service_client
+            from ..telemetry import profile_tensor_execution_order
+
+            params = self.unstack_params(state)
+            spans = profile_tensor_execution_order(self.loss_fn, params, batch)
+            if self._autotune_client is None:
+                self._autotune_client = get_hyperparameters_service_client()
+            self._autotune_client.report_tensor_execution_order(
+                spans, model_name=self.model_name
+            )
+            logger.info("telemetry: reported execution order for %d tensors",
+                        len(spans))
+        except Exception as e:  # telemetry must never take down training
+            logger.warning("telemetry report failed: %s", e)
 
     # ---- autotune check-in (reference distributed.py:213-242) ------------
 
@@ -492,6 +568,24 @@ class BaguaTrainer:
             buckets=[[TensorDeclaration(**d) for d in b] for b in buckets],
             is_hierarchical_reduce=bool(self.algorithm.hierarchical),
             bucket_size=self.bucket_bytes,
+        )
+
+    def _batch_spec(self) -> P:
+        if self.expert_axis is not None:
+            return P(self.dp_axes + (self.expert_axis,))
+        return P(self.dp_axes)
+
+    def shard_batch(self, local_batch):
+        """Stitch this process's local batch slice into global arrays laid
+        out for the train step — the multi-host input path (each process
+        feeds its own data shard, as each reference rank feeds its own
+        DataLoader split).  Single-process: an explicit device_put with the
+        step's input sharding (saves the jit-time relayout)."""
+        from ..parallel.mesh import make_global_array
+
+        spec = self._batch_spec()
+        return jax.tree.map(
+            lambda x: make_global_array(self.mesh, spec, x), local_batch
         )
 
     def unstack_params(self, state: TrainState):

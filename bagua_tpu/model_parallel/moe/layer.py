@@ -108,7 +108,26 @@ class MoEMLP(nn.Module):
         return y.reshape(b, s, d)
 
 
-def globalize_expert_params(params, rng, ep_size: int, keyword: str = "expert"):
+# The exact parameter names MoEMLP creates.  Marking is by path *segment*
+# equality against this set — the explicit analog of the reference's
+# ``param.expert = True`` flags (experts.py:26-29) — never by substring, so a
+# user param that merely contains "expert" in its name can't be silently
+# pulled out of the data-parallel plan.
+EXPERT_PARAM_NAMES = frozenset({"expert_wi", "expert_wo"})
+
+
+def is_expert_param(name: str) -> bool:
+    """True for params created by :class:`MoEMLP` (exact segment match).
+
+    Accepts any common path spelling: dotted (``a.b.expert_wi``), slashed,
+    or raw ``jax.tree_util.keystr`` output (``['a']['expert_wi']``).
+    """
+    import re
+
+    return not EXPERT_PARAM_NAMES.isdisjoint(re.split(r"[\[\]'\"./]+", name))
+
+
+def globalize_expert_params(params, rng, ep_size: int, is_expert=None):
     """Re-draw expert leaves at global shape for the expert-parallel trainer.
 
     ``model.init`` outside the mesh yields expert leaves of LOCAL shape
@@ -118,12 +137,14 @@ def globalize_expert_params(params, rng, ep_size: int, keyword: str = "expert"):
     the leading dim over ``'ep'``.  The returned tree is only valid inside the
     trainer (direct ``model.apply`` would see a shape mismatch).
     """
+    if is_expert is None:
+        is_expert = is_expert_param
     init = nn.initializers.lecun_normal(batch_axis=(0,))
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     out = []
     for path, leaf in flat:
         name = jax.tree_util.keystr(path)
-        if keyword in name and ep_size > 1:
+        if is_expert(name) and ep_size > 1:
             rng, sub = jax.random.split(rng)
             shape = (leaf.shape[0] * ep_size,) + leaf.shape[1:]
             out.append(init(sub, shape, leaf.dtype))

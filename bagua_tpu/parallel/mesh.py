@@ -121,3 +121,22 @@ def mesh_axis_size(mesh: Mesh, axes) -> int:
     if isinstance(axes, str):
         axes = (axes,)
     return int(math.prod(mesh.shape[a] for a in axes))
+
+
+def make_global_array(mesh: Mesh, spec, local):
+    """Assemble a global ``jax.Array`` from this process's local shard.
+
+    Multi-host input path (reference: each rank feeds its own DataLoader
+    shard; under JAX's single-program multi-controller model the per-process
+    batch slices must be stitched into one global array before entering the
+    jitted step).  ``local`` is this process's slice of the batch along the
+    sharded axes of ``spec``; single-process meshes pass through unchanged.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(np.asarray(local), sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(local))

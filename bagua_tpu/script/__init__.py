@@ -1,0 +1,1 @@
+"""Cluster-level launch scripts (reference bagua/script/)."""

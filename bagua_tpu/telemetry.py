@@ -1,0 +1,127 @@
+"""Telemetry producer: per-tensor gradient-readiness spans for the autotuner.
+
+Counterpart of the reference's OpenTelemetry span pipeline: the Rust backend
+opens a ``tensor_ready`` span per gradient as the backward pass marks it
+(bagua-core-internal/src/lib.rs:305-308), a custom exporter POSTs the batch to
+the autotune sidecar (bagua-opentelemetry/src/exporter/mod.rs:15-59), and the
+service re-orders buckets by the observed readiness order
+(service/autotune_service.py:274-294, autotune_task_manager.py:167-172).
+
+Under XLA the backward pass is one fused program — there is no per-tensor
+runtime event to hook.  What *is* observable, and is exactly the quantity the
+consumer needs, is each tensor's position in the backward schedule: the cost
+of backpropagating from the loss to that tensor alone.  Differentiating the
+loss w.r.t. a single leaf compiles a program containing the full forward plus
+the backward chain only as deep as that leaf, so its static cost (XLA's FLOP
+count) grows monotonically with backward depth — tensors near the loss (ready
+first) cost least.  We use that cost as the span timestamp: deterministic, no
+timing noise, no instrumentation in the hot path.  Wall-clock execution time
+is the fallback when the cost model is unavailable.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def _leaf_cost_flops(fn: Callable, leaf) -> Optional[float]:
+    """Static FLOP count of ``jit(fn)(leaf)`` via XLA's cost model."""
+    try:
+        compiled = jax.jit(fn).lower(leaf).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
+            analysis = analysis[0] if analysis else {}
+        flops = analysis.get("flops")
+        return float(flops) if flops is not None else None
+    except Exception as e:  # pragma: no cover - backend-dependent
+        logger.debug("cost_analysis unavailable (%s)", e)
+        return None
+
+
+def _leaf_cost_walltime(fn: Callable, leaf, repeats: int = 3) -> float:
+    compiled = jax.jit(fn)
+    jax.block_until_ready(compiled(leaf))  # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled(leaf))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def profile_tensor_execution_order(
+    loss_fn: Callable,
+    params: Any,
+    batch: Any,
+    max_tensors: int = 512,
+) -> List[Dict]:
+    """Measure per-tensor gradient readiness order; returns spans (dicts with
+    the reference's ``BaguaCoreTelemetrySpan`` shape) sorted by readiness.
+
+    ``loss_fn(params, batch) -> scalar`` must be the training loss;
+    ``params`` the user-shaped param pytree.  Cost scales with the number of
+    leaves (one compile each) — run off the hot path, once per autotune
+    registration.
+    """
+    from .tensor import _name_of_path
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    if len(flat) > max_tensors:
+        logger.warning(
+            "telemetry: profiling only the %d largest of %d tensors",
+            max_tensors, len(flat),
+        )
+        flat = sorted(flat, key=lambda kv: -kv[1].size)[:max_tensors]
+
+    names = [_name_of_path(path) for path, _ in flat]
+
+    def grad_fns():
+        for path, leaf in flat:
+
+            def grad_wrt_leaf(v, _path=path):
+                patched = _set_leaf(params, _path, v)
+                return loss_fn(patched, batch)
+
+            yield jax.grad(grad_wrt_leaf), leaf
+
+    # one consistent unit across ALL leaves: FLOPs when the cost model
+    # answers for every leaf, else wall-time nanoseconds for every leaf —
+    # mixing units would produce a garbage ordering
+    costs: List[float] = []
+    for g, leaf in grad_fns():
+        cost = _leaf_cost_flops(g, leaf)
+        if cost is None:
+            costs = []
+            break
+        costs.append(cost)
+    if not costs:
+        costs = [
+            _leaf_cost_walltime(g, leaf) * 1e9  # ns, so int() keeps order
+            for g, leaf in grad_fns()
+        ]
+
+    spans = [
+        {
+            "trace_id": 0,
+            "action": "tensor_ready",
+            "tensor_name": name,
+            "start_time": int(cost),
+            "end_time": int(cost),
+        }
+        for name, cost in zip(names, costs)
+    ]
+    spans.sort(key=lambda s: s["start_time"])
+    return spans
+
+
+def _set_leaf(tree, target_path, value):
+    """Replace the leaf at ``target_path`` with ``value`` (functional)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = [value if path == target_path else leaf for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,1 +1,17 @@
 __version__ = "0.1.0"
+
+
+def show_version() -> str:
+    """Build/runtime diagnostics (reference ``show_version``,
+    bagua-core-internal/src/lib.rs:103-123: shadow_rs build info + NCCL
+    version — here jax/jaxlib/backend in their place)."""
+    import jax
+
+    lines = [
+        f"bagua_tpu {__version__}",
+        f"jax {jax.__version__}",
+        f"backend {jax.default_backend()} ({len(jax.devices())} devices)",
+    ]
+    out = "\n".join(lines)
+    print(out)
+    return out

@@ -1,0 +1,110 @@
+"""Hang watchdog: convert silent stalls into crashes a launcher can restart.
+
+Counterpart of the reference's comm monitor thread, which panics the process
+when a scheduled comm op exceeds 300 s
+(/root/reference/rust/bagua-core/bagua-core-internal/src/lib.rs:255-265), and
+of its panic-escalation hook (bagua-core-py/src/lib.rs:518-523) — under XLA
+the analogous failure is a collective deadlock across ranks (e.g. one rank
+compiled a different program) that blocks ``block_until_ready`` forever.  A
+hung worker holds the whole gang; killing it lets
+``bagua_tpu.distributed.run``'s gang restart recover from the checkpoint.
+
+Enabled via ``BAGUA_COMM_TIMEOUT_S`` (default off).  When on, the trainer
+synchronizes each step inside a watched section — trading step-level async
+dispatch for hang detection, the same serialization the reference's comm
+monitor implies.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def get_comm_timeout_s() -> Optional[float]:
+    v = os.environ.get("BAGUA_COMM_TIMEOUT_S")
+    return float(v) if v else None
+
+
+class HangWatchdog:
+    """Monitors watched sections; if one runs past ``timeout_s``, dumps all
+    thread stacks and terminates the process (``action="exit"``) or raises in
+    the monitor (``action="log"``, for tests)."""
+
+    _CHECK_INTERVAL_S = 1.0
+
+    def __init__(self, timeout_s: float = 300.0, action: str = "exit"):
+        assert action in ("exit", "log")
+        self.timeout_s = timeout_s
+        self.action = action
+        self.fired = threading.Event()
+        self._active: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._monitor, name="bagua-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    @contextmanager
+    def watch(self, label: str = "comm"):
+        token = threading.get_ident()
+        with self._lock:
+            self._active[token] = (label, time.monotonic())
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._active.pop(token, None)
+
+    def _monitor(self):
+        while not self._stop.wait(self._CHECK_INTERVAL_S):
+            now = time.monotonic()
+            with self._lock:
+                overdue = [
+                    (label, now - t0)
+                    for label, t0 in self._active.values()
+                    if now - t0 > self.timeout_s
+                ]
+            if overdue:
+                label, dt = overdue[0]
+                logger.error(
+                    "watchdog: section %r stuck for %.0f s (timeout %.0f s) — "
+                    "dumping stacks", label, dt, self.timeout_s,
+                )
+                already_fired = self.fired.is_set()
+                self.fired.set()
+                if not already_fired:  # dump stacks once, not every tick
+                    faulthandler.dump_traceback(file=sys.stderr)
+                if self.action == "exit":
+                    # the gang-restart contract: die loudly, let the
+                    # launcher respawn from the checkpoint
+                    os._exit(3)
+                # log mode: keep monitoring (later hangs must also surface)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+_GLOBAL: Optional[HangWatchdog] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_global_watchdog(timeout_s: float) -> HangWatchdog:
+    """Process-wide watchdog (one monitor thread no matter how many trainers
+    exist — the reference also runs ONE comm monitor per backend process,
+    lib.rs:255-265).  The first caller's timeout wins."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = HangWatchdog(timeout_s)
+        return _GLOBAL

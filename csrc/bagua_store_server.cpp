@@ -1,0 +1,247 @@
+// Native KV store server for bagua_tpu's contrib cache layer.
+//
+// Role counterpart of the redis-server instances the reference's RedisStore
+// spawns per node (/root/reference/bagua/torch_api/contrib/utils/
+// redis_store.py:38+): a small native daemon holding the shared sample
+// cache, one per shard, fronted by the hash-sharded ClusterStore view.
+// Thread-per-connection; values are opaque byte strings.
+//
+// Wire protocol (little-endian, mirrored in contrib/utils/tcp_store.py):
+//   request:  u8 op | op payload; bytes fields are u32 len + raw
+//   ops:      1=SET k v  2=GET k  3=MSET n (k v)*  4=MGET n k*
+//             5=NUM_KEYS 6=CLEAR  7=PING           8=SHUTDOWN
+//   response: GET -> u8 present + [val]
+//             MGET -> u32 n + n*(u8 present + [val])
+//             NUM_KEYS -> u64;  others -> u8 0
+//
+// Usage: bagua_store_server <host> <port>   (port 0 = auto-pick)
+// Prints "LISTENING <port>" on stdout once bound.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  OP_SET = 1,
+  OP_GET = 2,
+  OP_MSET = 3,
+  OP_MGET = 4,
+  OP_NUM_KEYS = 5,
+  OP_CLEAR = 6,
+  OP_PING = 7,
+  OP_SHUTDOWN = 8,
+};
+
+std::unordered_map<std::string, std::string> g_data;
+std::mutex g_mu;
+std::atomic<bool> g_shutdown{false};
+int g_listen_fd = -1;
+
+bool recv_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// sanity caps: a desynced or malicious client must not make the shared
+// server allocate gigabytes from one malformed length field
+constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB per value
+constexpr uint32_t kMaxBatch = 1u << 20;  // keys per mset/mget
+
+bool recv_bytes(int fd, std::string* out) {
+  uint32_t len;
+  if (!recv_exact(fd, &len, 4)) return false;
+  if (len > kMaxFrame) return false;  // drop the connection
+  out->resize(len);
+  return len == 0 || recv_exact(fd, out->data(), len);
+}
+
+void append_u32(std::string* out, uint32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+
+void append_bytes(std::string* out, const std::string& v) {
+  append_u32(out, static_cast<uint32_t>(v.size()));
+  out->append(v);
+}
+
+void handle_conn(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::string key, val;
+  for (;;) {
+    uint8_t op;
+    if (!recv_exact(fd, &op, 1)) break;
+    switch (op) {
+      case OP_SET: {
+        if (!recv_bytes(fd, &key) || !recv_bytes(fd, &val)) goto done;
+        {
+          std::lock_guard<std::mutex> lk(g_mu);
+          g_data[key] = val;
+        }
+        uint8_t ack = 0;
+        if (!send_all(fd, &ack, 1)) goto done;
+        break;
+      }
+      case OP_GET: {
+        if (!recv_bytes(fd, &key)) goto done;
+        std::string reply;
+        {
+          std::lock_guard<std::mutex> lk(g_mu);
+          auto it = g_data.find(key);
+          if (it == g_data.end()) {
+            reply.push_back(0);
+          } else {
+            reply.push_back(1);
+            append_bytes(&reply, it->second);
+          }
+        }
+        if (!send_all(fd, reply.data(), reply.size())) goto done;
+        break;
+      }
+      case OP_MSET: {
+        uint32_t n;
+        if (!recv_exact(fd, &n, 4) || n > kMaxBatch) goto done;
+        std::vector<std::pair<std::string, std::string>> items(n);
+        for (uint32_t i = 0; i < n; ++i) {
+          if (!recv_bytes(fd, &items[i].first) ||
+              !recv_bytes(fd, &items[i].second))
+            goto done;
+        }
+        {
+          std::lock_guard<std::mutex> lk(g_mu);
+          for (auto& kv : items) g_data[std::move(kv.first)] = std::move(kv.second);
+        }
+        uint8_t ack = 0;
+        if (!send_all(fd, &ack, 1)) goto done;
+        break;
+      }
+      case OP_MGET: {
+        uint32_t n;
+        if (!recv_exact(fd, &n, 4) || n > kMaxBatch) goto done;
+        std::vector<std::string> keys(n);
+        for (uint32_t i = 0; i < n; ++i)
+          if (!recv_bytes(fd, &keys[i])) goto done;
+        std::string reply;
+        append_u32(&reply, n);
+        {
+          std::lock_guard<std::mutex> lk(g_mu);
+          for (const auto& k : keys) {
+            auto it = g_data.find(k);
+            if (it == g_data.end()) {
+              reply.push_back(0);
+            } else {
+              reply.push_back(1);
+              append_bytes(&reply, it->second);
+            }
+          }
+        }
+        if (!send_all(fd, reply.data(), reply.size())) goto done;
+        break;
+      }
+      case OP_NUM_KEYS: {
+        uint64_t n;
+        {
+          std::lock_guard<std::mutex> lk(g_mu);
+          n = g_data.size();
+        }
+        if (!send_all(fd, &n, 8)) goto done;
+        break;
+      }
+      case OP_CLEAR: {
+        {
+          std::lock_guard<std::mutex> lk(g_mu);
+          g_data.clear();
+        }
+        uint8_t ack = 0;
+        if (!send_all(fd, &ack, 1)) goto done;
+        break;
+      }
+      case OP_PING: {
+        uint8_t ack = 0;
+        if (!send_all(fd, &ack, 1)) goto done;
+        break;
+      }
+      case OP_SHUTDOWN: {
+        uint8_t ack = 0;
+        send_all(fd, &ack, 1);
+        g_shutdown.store(true);
+        ::shutdown(g_listen_fd, SHUT_RDWR);
+        goto done;
+      }
+      default:
+        goto done;  // unknown op: drop the connection
+    }
+  }
+done:
+  ::close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* host = argc > 1 ? argv[1] : "127.0.0.1";
+  int port = argc > 2 ? std::atoi(argv[2]) : 0;
+
+  g_listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (g_listen_fd < 0) return 1;
+  int one = 1;
+  ::setsockopt(g_listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) return 1;
+  if (::bind(g_listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+    return 1;
+  if (::listen(g_listen_fd, 128) != 0) return 1;
+
+  socklen_t len = sizeof(addr);
+  ::getsockname(g_listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  std::printf("LISTENING %d\n", ntohs(addr.sin_port));
+  std::fflush(stdout);
+
+  std::vector<std::thread> threads;
+  while (!g_shutdown.load()) {
+    int fd = ::accept(g_listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (g_shutdown.load()) break;
+      continue;
+    }
+    threads.emplace_back(handle_conn, fd);
+  }
+  ::close(g_listen_fd);
+  for (auto& t : threads)
+    if (t.joinable()) t.join();
+  return 0;
+}

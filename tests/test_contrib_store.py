@@ -85,3 +85,40 @@ def test_tcp_cluster_store_spawn_and_shutdown():
 
 def test_redis_store_is_gated():
     pytest.importorskip("redis", reason="redis not installed (expected here)")
+
+
+@pytest.mark.parametrize("backend", ["python", "cpp"])
+def test_tcp_store_both_backends(backend):
+    """Python and native C++ servers speak the same wire protocol; the same
+    client exercises either (reference parity: RedisStore fronts a native C
+    server, redis_store.py:38+)."""
+    if backend == "cpp":
+        from bagua_tpu.contrib.utils.native_build import ensure_store_server
+
+        if ensure_store_server() is None:
+            pytest.skip("no C++ toolchain")
+    server = TCPStoreServer(backend=backend)
+    try:
+        assert server.is_native == (backend == "cpp")
+        _exercise_store(TCPStore(*server.address))
+        # large value round-trip (multi-recv framing)
+        c = TCPStore(*server.address)
+        big = bytes(range(256)) * 4096  # 1 MiB
+        c.set("big", big)
+        assert c.get("big") == big
+    finally:
+        server.stop()
+
+
+def test_native_server_shutdown_op():
+    from bagua_tpu.contrib.utils.native_build import ensure_store_server
+
+    if ensure_store_server() is None:
+        pytest.skip("no C++ toolchain")
+    server = TCPStoreServer(backend="cpp")
+    c = TCPStore(*server.address)
+    c.set("k", b"v")
+    c.shutdown()  # server process exits
+    server._proc.wait(timeout=10)
+    assert server._proc.returncode == 0
+    server._proc = None  # already exited; stop() must not re-wait

@@ -96,23 +96,29 @@ def test_matches_per_rank_golden(mode):
 
 
 def test_all_mode_ranks_identical():
+    """Exact peer-equality at the communication point (reference
+    test_decentralized.py:290-315): in "all" mode, the post-communication
+    weights every rank holds must be IDENTICAL — pmean returns the same
+    reduction result on all ranks.  track_peer_weights exposes those weights
+    (the analog of the reference's peer_weight bucket tensor)."""
     model, params, loss_fn = _setup(1)
     trainer = BaguaTrainer(
         loss_fn, optax.sgd(LR),
-        DecentralizedAlgorithm(hierarchical=False, peer_selection_mode="all"),
+        DecentralizedAlgorithm(hierarchical=False, peer_selection_mode="all",
+                               track_peer_weights=True),
     )
     st = trainer.init(params)
     for b in _batches(3, seed=1):
         st, _ = trainer.train_step(st, {"x": jnp.asarray(b["x"]), "y": jnp.asarray(b["y"])})
-    # after the averaging step all ranks saw the same pre-step weights but
-    # applied different local grads; average again to compare the invariant:
-    # rank weights must all equal (weights diverge only by one local step)
+    for flat in st.algo_state["peer_weights"]:
+        arr = np.asarray(flat)  # [nranks, bucket_elems]
+        for r in range(1, arr.shape[0]):
+            np.testing.assert_array_equal(arr[r], arr[0])
+    # and the post-step weights differ from peer weights only by one local
+    # SGD step (each rank applied its own grads to the common average)
     leaves = jax.tree.leaves(st.params)
     for leaf in leaves:
         arr = np.asarray(leaf)
-        # invariant from reference test: in "all" mode peers coincide after
-        # communication; our state is post-step so check spread is the size
-        # of one SGD step, not divergent
         assert np.abs(arr - arr.mean(axis=0, keepdims=True)).max() < LR * 50
 
 
@@ -145,3 +151,25 @@ def test_communication_interval():
     for b in _batches(4, seed=3):
         st, loss = trainer.train_step(st, {"x": jnp.asarray(b["x"]), "y": jnp.asarray(b["y"])})
     assert np.isfinite(float(loss))
+
+
+def test_track_peer_weights_survives_skip_steps():
+    """With communication_interval > 1, non-communication steps must KEEP the
+    last communicated peer weights instead of overwriting them with local
+    (divergent) weights."""
+    model, params, loss_fn = _setup(1)
+    trainer = BaguaTrainer(
+        loss_fn, optax.sgd(LR),
+        DecentralizedAlgorithm(hierarchical=False, peer_selection_mode="all",
+                               communication_interval=2,
+                               track_peer_weights=True),
+    )
+    st = trainer.init(params)
+    # 3 steps: comm at step 0 and 2; step 1 skips — peer_weights must stay
+    # rank-identical after every step
+    for b in _batches(3, seed=3):
+        st, _ = trainer.train_step(st, {"x": jnp.asarray(b["x"]), "y": jnp.asarray(b["y"])})
+        for flat in st.algo_state["peer_weights"]:
+            arr = np.asarray(flat)
+            for r in range(1, arr.shape[0]):
+                np.testing.assert_array_equal(arr[r], arr[0])

@@ -12,6 +12,14 @@ from bagua_tpu.distributed.run import build_env, parse_args
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_parse_rejects_elastic_range():
     with pytest.raises(SystemExit):
         parse_args(["--nnodes", "1:4", "script.py"])
@@ -59,3 +67,35 @@ def test_gang_restart_resumes_from_checkpoint(tmp_path):
     assert "injected crash" in out.stdout
     assert "resumed from checkpoint step" in out.stdout
     assert "final_loss" in out.stdout
+
+
+@pytest.mark.slow
+def test_multiprocess_bringup_trains_one_mesh(tmp_path):
+    """Two CPU JAX processes jax.distributed.initialize into ONE mesh via the
+    launcher and train together — the only path exercising
+    init_process_group's coordinator bring-up (communication.py) and
+    per-process batch feeding (trainer.shard_batch)."""
+    env = dict(os.environ)
+    env["BAGUA_TEST_OUT"] = str(tmp_path)
+    env.pop("BAGUA_SERVICE_PORT", None)
+    port = _free_port()
+    cmd = [
+        sys.executable, "-m", "bagua_tpu.distributed.run",
+        "--nproc_per_node", "2",
+        "--simulate_cpu_devices", "1",
+        "--master_port", str(port),
+        "--bagua_service_port", "-1",
+        "--max_restarts", "0",
+        os.path.join(REPO, "tests", "workers", "multiproc_train_worker.py"),
+    ]
+    out = subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=420
+    )
+    sys.stderr.write(out.stdout[-2000:] + out.stderr[-2000:])
+    assert out.returncode == 0
+    r0 = (tmp_path / "rank0.txt").read_text()
+    r1 = (tmp_path / "rank1.txt").read_text()
+    # SPMD: every process computes the identical replicated loss sequence
+    assert r0 == r1
+    losses = eval(r0)
+    assert losses[-1] < losses[0]

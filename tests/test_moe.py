@@ -210,3 +210,56 @@ def test_moe_expert_parallel_trains_e2e():
         if name.endswith("['embed']['embedding']"):
             for r in range(1, arr.shape[0]):
                 np.testing.assert_allclose(arr[0], arr[r], atol=1e-6)
+
+
+def test_expert_param_marking_is_exact_not_substring():
+    """An unrelated param containing "expert" as a substring must stay in the
+    DP plan; only MoEMLP's own params (exact segment names) are excluded."""
+    from bagua_tpu.model_parallel.moe.layer import is_expert_param
+
+    assert is_expert_param("layers_1.mlp.expert_wi")
+    assert is_expert_param("['layers_1']['mlp']['expert_wo']")
+    assert not is_expert_param("encoder.expertise_head.kernel")
+    assert not is_expert_param("my_expert_wi_extra.kernel")
+    assert not is_expert_param("dense.kernel")
+
+
+def test_trainer_rejects_unknown_expert_axis():
+    import optax
+    import pytest
+
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"dp": 8})
+    with pytest.raises(ValueError, match="expert_axis"):
+        BaguaTrainer(lambda p, b: 0.0, optax.sgd(0.1),
+                     GradientAllReduceAlgorithm(), mesh=mesh,
+                     expert_axis="not_an_axis")
+    with pytest.raises(ValueError, match="seq_axis"):
+        BaguaTrainer(lambda p, b: 0.0, optax.sgd(0.1),
+                     GradientAllReduceAlgorithm(), mesh=mesh,
+                     seq_axis="sq")
+
+
+def test_trainer_accepts_explicit_expert_params_collection():
+    import optax
+
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"dp": 4, "ep": 2})
+    t = BaguaTrainer(lambda p, b: 0.0, optax.sgd(0.1),
+                     GradientAllReduceAlgorithm(), mesh=mesh,
+                     expert_axis="ep",
+                     expert_params=["blk.moe.expert_wi", "blk.moe.expert_wo"])
+    assert t._is_expert_name("blk.moe.expert_wi")
+    assert not t._is_expert_name("blk.attn.kernel")
+    # callable form
+    t2 = BaguaTrainer(lambda p, b: 0.0, optax.sgd(0.1),
+                      GradientAllReduceAlgorithm(), mesh=mesh,
+                      expert_axis="ep",
+                      expert_params=lambda n: n.endswith("_moe"))
+    assert t2._is_expert_name("w_moe")

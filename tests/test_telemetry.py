@@ -1,0 +1,136 @@
+"""Telemetry producer tests: gradient-readiness order profiling and the
+end-to-end feed into the autotune sidecar's bucket reordering (the
+reference's OTel span pipeline, SURVEY.md §5.1)."""
+
+import threading
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from bagua_tpu.core.backend import BaguaTrainer
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.parallel.mesh import build_mesh
+from bagua_tpu.service.autotune_service import AutotuneService, make_server
+from bagua_tpu.telemetry import profile_tensor_execution_order
+
+N_DEVICES = 8
+
+
+class ChainMLP(nn.Module):
+    """4-layer chain; layer names chosen so alphabetical traversal order does
+    NOT match backward order — telemetry must recover the true order."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(32, name="a_first")(x)     # deepest from the loss
+        x = nn.relu(x)
+        x = nn.Dense(32, name="m_second")(x)
+        x = nn.relu(x)
+        x = nn.Dense(8, name="z_last")(x)       # nearest the loss
+        return x
+
+
+def _chain_setup():
+    model = ChainMLP()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+    y = jnp.argmax(x @ jax.random.normal(jax.random.PRNGKey(1), (4, 8)), -1)
+    params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    return model, params, loss_fn, {"x": x, "y": y}
+
+
+def test_profile_orders_by_backward_depth():
+    """Params near the loss become ready first; the profiler's span order
+    must reflect backward depth, not traversal order."""
+    _, params, loss_fn, batch = _chain_setup()
+    spans = profile_tensor_execution_order(loss_fn, params, batch)
+    assert {s["action"] for s in spans} == {"tensor_ready"}
+    pos = {s["tensor_name"]: i for i, s in enumerate(spans)}
+    # layer groups: z_last (ready first) < m_second < a_first (ready last)
+    z = max(v for k, v in pos.items() if "z_last" in k)
+    m_lo = min(v for k, v in pos.items() if "m_second" in k)
+    m_hi = max(v for k, v in pos.items() if "m_second" in k)
+    a = min(v for k, v in pos.items() if "a_first" in k)
+    assert z < m_lo, pos
+    assert m_hi < a, pos
+
+
+def test_service_reorders_buckets_from_spans():
+    """POSTed spans change the bucket composition the service recommends —
+    the consumer path the reference drives from its Rust exporter."""
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=10,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0,
+        default_bucket_size=1 << 30,
+    )
+    decls = [
+        {"name": f"t{i}", "num_elements": 64, "dtype": "f32"} for i in range(6)
+    ]
+    service.register_tensors({"model_name": "m", "tensor_list": decls})
+    # without spans: declaration order
+    rsp = service.ask_hyperparameters({"model_name": "m", "rank": 0, "train_iter": 1})
+    # readiness order reversed vs declaration
+    spans = [
+        {"trace_id": 0, "action": "tensor_ready", "tensor_name": f"t{i}",
+         "start_time": 100 - i, "end_time": 100 - i}
+        for i in range(6)
+    ]
+    service.report_tensor_execution_order({"model_name": "m", "spans": spans})
+    service.report_metrics(
+        {"model_name": "m", "rank": 0, "train_iter": 2,
+         "hyperparameters": rsp["recommended_hyperparameters"], "speed": 100.0}
+    )
+    rsp2 = service.ask_hyperparameters({"model_name": "m", "rank": 0, "train_iter": 200})
+    buckets = rsp2["recommended_hyperparameters"]["buckets"]
+    flat_order = [t["name"] for b in buckets for t in b]
+    assert flat_order == [f"t{i}" for i in reversed(range(6))]
+
+
+@pytest.fixture()
+def live_autotune(monkeypatch):
+    service = AutotuneService(
+        world_size=1, autotune_level=2, max_samples=5,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0,
+        default_bucket_size=1024,
+    )
+    server = make_server(0, service)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    monkeypatch.setenv("BAGUA_SERVICE_PORT", str(port))
+    monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+    monkeypatch.setenv("BAGUA_AUTOTUNE", "2")
+    from bagua_tpu import communication
+
+    communication.get_hyperparameters_service_client.cache_clear()
+    yield service
+    server.shutdown()
+    communication.get_hyperparameters_service_client.cache_clear()
+
+
+def test_trainer_feeds_telemetry_end_to_end(live_autotune):
+    """The trainer's producer POSTs real spans on the first step and the
+    service's task manager holds the readiness order afterwards."""
+    _, params, loss_fn, batch = _chain_setup()
+    mesh = build_mesh({"dp": N_DEVICES})
+    trainer = BaguaTrainer(
+        loss_fn, optax.sgd(0.1), GradientAllReduceAlgorithm(),
+        mesh=mesh, model_name="tele", autotune=True,
+    )
+    state = trainer.init(params)
+    state, _ = trainer.train_step(state, batch)
+    assert trainer._telemetry_reported
+    task = live_autotune._task("tele")
+    order = task.manager.tensor_partial_order
+    assert order, "service never received spans"
+    z = max(v for k, v in order.items() if "z_last" in k)
+    a = min(v for k, v in order.items() if "a_first" in k)
+    assert z < a, order

@@ -1,0 +1,36 @@
+"""Watchdog + version diagnostics tests (reference comm monitor lib.rs:255-265
+and show_version lib.rs:103-123)."""
+
+import time
+
+
+def test_watchdog_fires_on_stuck_section():
+    from bagua_tpu.watchdog import HangWatchdog
+
+    wd = HangWatchdog(timeout_s=1.0, action="log")
+    wd._CHECK_INTERVAL_S = 0.1
+    with wd.watch("stuck"):
+        deadline = time.time() + 5
+        while not wd.fired.is_set() and time.time() < deadline:
+            time.sleep(0.1)
+    assert wd.fired.is_set()
+    wd.stop()
+
+
+def test_watchdog_quiet_on_fast_sections():
+    from bagua_tpu.watchdog import HangWatchdog
+
+    wd = HangWatchdog(timeout_s=2.0, action="log")
+    for _ in range(5):
+        with wd.watch("fast"):
+            time.sleep(0.01)
+    time.sleep(0.3)
+    assert not wd.fired.is_set()
+    wd.stop()
+
+
+def test_show_version():
+    from bagua_tpu.version import show_version
+
+    out = show_version()
+    assert "bagua_tpu" in out and "jax" in out

@@ -1,0 +1,66 @@
+"""Worker for the multi-process bring-up test.
+
+Launched by ``bagua_tpu.distributed.run`` with ``--nproc_per_node N
+--simulate_cpu_devices 1``: each process owns ONE virtual CPU device,
+``init_process_group`` runs ``jax.distributed.initialize`` against the
+launcher-provided coordinator (the reference's NCCL-unique-id rendezvous,
+SURVEY.md §3.6), and the global mesh spans all processes.  Trains a fixed
+teacher task for a few steps and writes per-rank final losses to
+``BAGUA_TEST_OUT`` for the test to compare.
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import bagua_tpu  # noqa: E402
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm  # noqa: E402
+from bagua_tpu.models.mlp import MLP  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    mesh = bagua_tpu.init_process_group()
+    assert jax.process_count() == world, (jax.process_count(), world)
+    assert len(jax.devices()) == world, (len(jax.devices()), world)
+
+    model = MLP(features=(16, 8))
+    teacher = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    x_global = jax.random.normal(jax.random.PRNGKey(0), (8 * world, 4))
+    y_global = jnp.argmax(x_global @ teacher, -1)
+    params = model.init(jax.random.PRNGKey(2), x_global[:2])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    trainer = bagua_tpu.BaguaTrainer(
+        loss_fn, optax.sgd(0.2), GradientAllReduceAlgorithm(), mesh=mesh
+    )
+    state = trainer.init(params)
+    # each process feeds only ITS slice of the batch (multi-host input path)
+    lo, hi = rank * 8, (rank + 1) * 8
+    local = {"x": np.asarray(x_global[lo:hi]), "y": np.asarray(y_global[lo:hi])}
+    batch = trainer.shard_batch(local)
+    losses = []
+    for _ in range(5):
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    out = os.environ["BAGUA_TEST_OUT"]
+    with open(os.path.join(out, f"rank{rank}.txt"), "w") as f:
+        f.write(repr(losses))
+
+
+if __name__ == "__main__":
+    main()
