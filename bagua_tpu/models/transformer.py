@@ -49,11 +49,14 @@ class TransformerConfig:
 
 
 def bert_large_config(**kw) -> TransformerConfig:
-    """BERT-Large-scale shapes (the reference's SQuAD workload scale)."""
-    return TransformerConfig(
+    """BERT-Large-scale shapes (the reference's SQuAD workload scale).
+    Keyword overrides (e.g. ``max_seq_len=384`` for SQuAD) replace defaults."""
+    defaults = dict(
         vocab_size=30528, d_model=1024, n_heads=16, n_layers=24, d_ff=4096,
-        max_seq_len=512, **kw,
+        max_seq_len=512,
     )
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
 
 
 class RMSNorm(nn.Module):
@@ -144,6 +147,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
     attn_fn: Optional[Callable] = None
     mlp_factory: Optional[Callable[[int], Optional[Callable]]] = None
+    head: bool = True  # False: return final hidden states (encoder trunk)
 
     @nn.compact
     def __call__(self, tokens):
@@ -167,6 +171,8 @@ class TransformerLM(nn.Module):
             mlp = self.mlp_factory(i) if self.mlp_factory is not None else None
             x = block_cls(cfg, self.attn_fn, mlp, name=f"block_{i}")(x)
         x = RMSNorm(cfg.dtype, cfg.param_dtype, name="final_norm")(x)
+        if not self.head:
+            return x.astype(jnp.float32)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="lm_head",

@@ -55,56 +55,96 @@ def _leaf_cost_walltime(fn: Callable, leaf, repeats: int = 3) -> float:
     return best
 
 
+def _first_use_costs(loss_fn, params, batch) -> Optional[List[float]]:
+    """Readiness cost per leaf from ONE jaxpr trace (no compiles).
+
+    Reverse-mode autodiff produces gradients in roughly the reverse of
+    forward execution order, and a parameter's forward position is the index
+    of the first equation consuming it — so readiness rank = descending
+    first-use index.  One trace regardless of model size (BERT-Large has
+    ~400 leaves; per-leaf compilation would block the first step for hours).
+    """
+    leaves, _ = jax.tree_util.tree_flatten(params)
+    try:
+        closed = jax.make_jaxpr(lambda p: loss_fn(p, batch))(params)
+    except Exception as e:  # pragma: no cover - loss_fn may need real arrays
+        logger.debug("telemetry: trace failed (%s)", e)
+        return None
+    jaxpr = closed.jaxpr
+    invars = jaxpr.invars[: len(leaves)]  # flattened params come first
+    first_use = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not isinstance(v, jax.extend.core.Var):
+                continue
+            if v not in first_use:
+                first_use[v] = i
+    n = len(jaxpr.eqns) + 1
+    # used earlier in forward -> gradient ready LATER -> larger cost
+    return [float(n - first_use.get(v, n)) for v in invars]
+
+
 def profile_tensor_execution_order(
     loss_fn: Callable,
     params: Any,
     batch: Any,
     max_tensors: int = 512,
+    mode: str = "static",
 ) -> List[Dict]:
     """Measure per-tensor gradient readiness order; returns spans (dicts with
     the reference's ``BaguaCoreTelemetrySpan`` shape) sorted by readiness.
 
     ``loss_fn(params, batch) -> scalar`` must be the training loss;
-    ``params`` the user-shaped param pytree.  Cost scales with the number of
-    leaves (one compile each) — run off the hot path, once per autotune
-    registration.
+    ``params`` the user-shaped param pytree.  ``mode="static"`` (default)
+    derives the order from one jaxpr trace — O(1) compiles, safe to run
+    inline.  ``mode="flops"`` compiles a grad-to-leaf program per tensor and
+    uses XLA's FLOP count (more precise, one compile per leaf — only for
+    offline analysis of small models).
     """
     from .tensor import _name_of_path
 
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    if len(flat) > max_tensors:
-        logger.warning(
-            "telemetry: profiling only the %d largest of %d tensors",
-            max_tensors, len(flat),
-        )
-        flat = sorted(flat, key=lambda kv: -kv[1].size)[:max_tensors]
+    names_all = [_name_of_path(path) for path, _ in flat]
 
-    names = [_name_of_path(path) for path, _ in flat]
+    if mode == "static":
+        costs = _first_use_costs(loss_fn, params, batch)
+        names = names_all
+        if costs is None:
+            mode = "flops"  # trace failed; fall through to measurement
 
-    def grad_fns():
-        for path, leaf in flat:
+    if mode == "flops":
+        if len(flat) > max_tensors:
+            logger.warning(
+                "telemetry: profiling only the %d largest of %d tensors",
+                max_tensors, len(flat),
+            )
+            flat = sorted(flat, key=lambda kv: -kv[1].size)[:max_tensors]
+        names = [_name_of_path(path) for path, _ in flat]
 
-            def grad_wrt_leaf(v, _path=path):
-                patched = _set_leaf(params, _path, v)
-                return loss_fn(patched, batch)
+        def grad_fns():
+            for path, leaf in flat:
 
-            yield jax.grad(grad_wrt_leaf), leaf
+                def grad_wrt_leaf(v, _path=path):
+                    patched = _set_leaf(params, _path, v)
+                    return loss_fn(patched, batch)
 
-    # one consistent unit across ALL leaves: FLOPs when the cost model
-    # answers for every leaf, else wall-time nanoseconds for every leaf —
-    # mixing units would produce a garbage ordering
-    costs: List[float] = []
-    for g, leaf in grad_fns():
-        cost = _leaf_cost_flops(g, leaf)
-        if cost is None:
-            costs = []
-            break
-        costs.append(cost)
-    if not costs:
-        costs = [
-            _leaf_cost_walltime(g, leaf) * 1e9  # ns, so int() keeps order
-            for g, leaf in grad_fns()
-        ]
+                yield jax.grad(grad_wrt_leaf), leaf
+
+        # one consistent unit across ALL leaves: FLOPs when the cost model
+        # answers for every leaf, else wall-time nanoseconds for every
+        # leaf — mixing units would produce a garbage ordering
+        costs = []
+        for g, leaf in grad_fns():
+            cost = _leaf_cost_flops(g, leaf)
+            if cost is None:
+                costs = []
+                break
+            costs.append(cost)
+        if not costs:
+            costs = [
+                _leaf_cost_walltime(g, leaf) * 1e9  # ns: int() keeps order
+                for g, leaf in grad_fns()
+            ]
 
     spans = [
         {

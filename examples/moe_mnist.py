@@ -1,0 +1,114 @@
+"""MoE MNIST example — expert parallelism end to end.
+
+Counterpart of /root/reference/examples/moe/mnist_main.py (an MNIST net whose
+hidden layer is a DeepSpeed-style MoE, trained under with_bagua and gated in
+CI on an exact final loss, benchmark_master.sh:126-153).  Uses
+MNIST-shaped synthetic data (no dataset download in this image); pass
+``--mnist-dir`` with the standard IDX files to train on real MNIST.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/moe_mnist.py --steps 60
+"""
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import bagua_tpu
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.model_parallel.moe import MoEMLP
+from bagua_tpu.model_parallel.moe.layer import globalize_expert_params
+from bagua_tpu.parallel.mesh import build_mesh
+
+import flax.linen as nn
+
+
+class MoEMnistNet(nn.Module):
+    """Conv stem -> MoE hidden layer -> classifier (reference mnist_main.py
+    shape: two convs, an MoE fc1, fc2 head)."""
+
+    n_experts: int = 4
+    ep_size: int = 1
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, 28, 28, 1]
+        x = nn.relu(nn.Conv(16, (3, 3), (2, 2))(x))
+        x = nn.relu(nn.Conv(32, (3, 3), (2, 2))(x))
+        x = x.reshape(x.shape[0], 1, -1)          # [B, 1, feat] as tokens
+        x = nn.Dense(64)(x)
+        x = MoEMLP(n_experts=self.n_experts, d_ff=128,
+                   ep_size=self.ep_size, k=1)(x)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(10)(x)
+
+
+def load_batches(args, rng):
+    if args.mnist_dir:
+        import gzip
+        import struct
+
+        with gzip.open(os.path.join(args.mnist_dir, "train-images-idx3-ubyte.gz")) as f:
+            _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(num, rows, cols, 1)
+        with gzip.open(os.path.join(args.mnist_dir, "train-labels-idx1-ubyte.gz")) as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8)
+        images = images.astype(np.float32) / 255.0
+    else:  # synthetic MNIST-shaped, deterministic
+        images = rng.normal(size=(args.batch * 8, 28, 28, 1)).astype(np.float32)
+        labels = rng.integers(0, 10, args.batch * 8)
+    return images, labels.astype(np.int32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--mnist-dir", type=str, default=None)
+    args = ap.parse_args()
+
+    bagua_tpu.init_process_group()
+    n_dev = len(jax.devices())
+    ep = n_dev if n_dev > 1 else 1
+    mesh = build_mesh({"dp": 1, "ep": ep}) if ep > 1 else build_mesh()
+
+    model = MoEMnistNet(n_experts=max(4, ep), ep_size=ep)
+    rng = np.random.default_rng(0)
+    images, labels = load_batches(args, rng)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(images[:2]))["params"]
+    if ep > 1:
+        params = globalize_expert_params(params, jax.random.PRNGKey(1), ep_size=ep)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+
+    trainer = bagua_tpu.BaguaTrainer(
+        loss_fn, optax.adam(args.lr), GradientAllReduceAlgorithm(),
+        mesh=mesh, expert_axis="ep" if ep > 1 else None,
+    )
+    state = trainer.init(params)
+
+    losses = []
+    for step in range(args.steps):
+        lo = (step * args.batch) % (len(images) - args.batch)
+        batch = trainer.shard_batch({
+            "x": images[lo:lo + args.batch], "y": labels[lo:lo + args.batch],
+        })
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+        if step % 10 == 0:
+            print(f"step {step} loss {losses[-1]:.6f}")
+    print(f"final_loss {losses[-1]:.6f}")
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+if __name__ == "__main__":
+    main()

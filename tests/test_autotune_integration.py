@@ -44,7 +44,10 @@ def autotune_env(monkeypatch):
 
 def test_trainer_autotune_round_trip(autotune_env):
     service = autotune_env
-    model = MLP(features=(16, 8))
+    # big enough that different recommended bucket sizes (2^10..) yield
+    # DIFFERENT partitions — a 16x8 model fits one minimum-size bucket and
+    # could never re-bucket
+    model = MLP(features=(256, 64, 8))
     mesh = build_mesh({"dp": N_DEVICES})
     x = jax.random.normal(jax.random.PRNGKey(0), (N_DEVICES * 2, 4))
     w = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
@@ -67,10 +70,18 @@ def test_trainer_autotune_round_trip(autotune_env):
     assert task.tensor_list, "trainer must register tensors at init"
 
     batch = {"x": x, "y": y}
+    signatures = set()
     for i in range(301):
         state, loss = trainer.train_step(state, batch)
         trainer.record_speed(x.shape[0])
+        signatures.add(trainer._plan.signature())
     # 3 check-ins at steps 100/200/300 with max_samples=2 -> completed
     assert task.n_samples >= 2
     assert trainer._autotune_completed
     assert float(loss) < 2.0
+    # the recommendation must actually change the bucket signature under
+    # load, and each distinct signature gets its own compiled step
+    assert len(signatures) > 1, "autotune never re-bucketed"
+    assert len(trainer._step_cache) == len(
+        {(s,) for s in signatures}
+    ) or len(trainer._step_cache) > 1

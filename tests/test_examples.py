@@ -1,0 +1,65 @@
+"""Examples smoke tests (the reference CI runs its examples as gates,
+benchmark_master.sh:110-153; these run the fast ones on the CPU mesh)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+    env.pop("BAGUA_SERVICE_PORT", None)
+    env["BAGUA_SERVICE_PORT"] = "-1"
+    # bootstrap via -c: an accelerator-plugin sitecustomize can pre-empt the
+    # JAX_PLATFORMS env var, so pin the platform in jax.config first
+    path = os.path.join(REPO, "examples", script)
+    code = (
+        "import jax, runpy, sys; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        f"sys.argv = [{path!r}, *{list(args)!r}]; "
+        f"runpy.run_path({path!r}, run_name='__main__')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env,
+    )
+    sys.stderr.write(out.stdout[-1500:] + out.stderr[-1500:])
+    assert out.returncode == 0
+    return out.stdout
+
+
+def test_communication_primitives_example():
+    out = _run_example("communication_primitives.py")
+    assert "communication primitives OK (world=8)" in out
+
+
+@pytest.mark.slow
+def test_moe_mnist_example():
+    out = _run_example("moe_mnist.py", "--steps", "15", "--batch", "32")
+    assert "final_loss" in out
+
+
+@pytest.mark.slow
+def test_squad_finetune_example_tiny():
+    out = _run_example(
+        "squad_finetune.py", "--tiny", "--steps", "4", "--batch", "1",
+        "--seq", "64", "--algorithm", "qadam", "--lr", "1e-3",
+    )
+    assert "final_loss" in out
+
+
+@pytest.mark.slow
+def test_imagenet_resnet_example_tiny():
+    out = _run_example(
+        "imagenet_resnet.py", "--steps", "2", "--tiny",
+        "--batch-per-device", "1",
+    )
+    assert "final_loss" in out and "cache_entries" in out
