@@ -75,18 +75,20 @@ class RMSNorm(nn.Module):
 
 
 def causal_attention(q, k, v, dtype):
-    """Plain causal attention; softmax in f32, matmuls in ``dtype``.
+    """Causal attention; softmax in f32, matmuls in ``dtype``.
 
     ``q/k/v``: [batch, seq, heads, head_dim].  The SP paths (ring/Ulysses)
     provide drop-in replacements with the same signature.
+
+    On TPU with block-aligned sequence lengths this dispatches to the fused
+    Pallas flash-attention kernel (:mod:`bagua_tpu.ops.flash_attention`),
+    which never materializes the [seq, seq] score matrix; elsewhere it runs
+    the plain jnp form (identical math).  ``BAGUA_FLASH_ATTENTION=0``
+    disables the kernel.
     """
-    b, s, h, d = q.shape
-    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
-    logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    from ..ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, dtype, causal=True)
 
 
 class Attention(nn.Module):

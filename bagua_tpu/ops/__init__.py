@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7: native-code budget goes
+to Pallas where XLA can't express the fusion)."""
+
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_supported,
+    reference_attention,
+)

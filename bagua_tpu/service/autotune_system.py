@@ -82,8 +82,13 @@ def _score(result: Dict) -> float:
 
 
 def sysperf(args) -> int:
+    from concurrent.futures import ThreadPoolExecutor
+
     hosts = [h.strip() for h in args.host_list.split(",") if h.strip()]
-    results = [probe_host(args, h) for h in hosts]
+    # probe all hosts concurrently (the reference fans out with parallel-ssh;
+    # serial probing would serialize per-host timeouts on a hung fleet)
+    with ThreadPoolExecutor(max_workers=min(len(hosts), 64)) as pool:
+        results = list(pool.map(lambda h: probe_host(args, h), hosts))
     scores = {r["host"]: _score(r) for r in results if r["ok"]}
     median = statistics.median(scores.values()) if scores else 0.0
     rc = 0

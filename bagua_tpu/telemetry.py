@@ -106,6 +106,9 @@ def profile_tensor_execution_order(
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     names_all = [_name_of_path(path) for path, _ in flat]
 
+    if mode not in ("static", "flops"):
+        raise ValueError(f"unknown telemetry mode {mode!r}")
+
     if mode == "static":
         costs = _first_use_costs(loss_fn, params, batch)
         names = names_all

@@ -187,6 +187,50 @@ def bench_bert(mesh, n_dev: int) -> dict:
     }
 
 
+def bench_longctx(mesh, n_dev: int) -> dict:
+    """Long-context LM throughput — the flash-attention (Pallas) hot path.
+    ``vs_baseline`` is the speedup over the same model with the plain
+    materializing attention (the reference framework's only option, SURVEY.md
+    §5.7: it has no long-context support at all)."""
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss_fn,
+    )
+    from bagua_tpu.ops.flash_attention import reference_attention
+
+    cfg = TransformerConfig(
+        vocab_size=32768, d_model=1024, n_heads=16, n_layers=4, d_ff=4096,
+        max_seq_len=4096, remat=True,
+    )
+    batch = 2 * n_dev
+    tokens = jnp.zeros((batch, cfg.max_seq_len + 1), jnp.int32)
+
+    def run(attn_fn):
+        model = TransformerLM(cfg, attn_fn=attn_fn)
+        params = model.init(jax.random.PRNGKey(0), tokens[:2, :128])["params"]
+        trainer = BaguaTrainer(
+            lm_loss_fn(model), optax.adamw(1e-4),
+            GradientAllReduceAlgorithm(hierarchical=False),
+            mesh=mesh, autotune=False,
+        )
+        state = trainer.init(params)
+        data = trainer.shard_batch({"tokens": tokens})
+        dt, _, _ = _time_steps(trainer, state, data, timed=10)
+        return 10 * batch * cfg.max_seq_len / dt
+
+    flash_tps = run(None)  # dispatches to the Pallas kernel on TPU
+    plain_tps = run(
+        lambda q, k, v, dtype: reference_attention(q, k, v, dtype)
+    )
+    return {
+        "metric": "longctx_lm_seq4096_tokens_per_sec",
+        "value": round(flash_tps, 0),
+        "unit": "tok/s",
+        "vs_baseline": round(flash_tps / plain_tps, 3),
+    }
+
+
 def loss_goldens(n_steps: int = 30) -> dict:
     """Deterministic final losses per family on a fixed seed/task — the
     analog of the reference's exact-loss CI gate (benchmark_master.sh:98-108).
@@ -251,6 +295,7 @@ def main():
             records.append(_emit(bench_family(family, factory, mesh, n_dev)))
         records.append(_emit(bench_moe(mesh, n_dev)))
         records.append(_emit(bench_bert(mesh, n_dev)))
+        records.append(_emit(bench_longctx(mesh, n_dev)))
         with open("BENCH_SUITE.json", "w") as f:
             json.dump(records, f, indent=1)
         return

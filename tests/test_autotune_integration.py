@@ -82,6 +82,4 @@ def test_trainer_autotune_round_trip(autotune_env):
     # the recommendation must actually change the bucket signature under
     # load, and each distinct signature gets its own compiled step
     assert len(signatures) > 1, "autotune never re-bucketed"
-    assert len(trainer._step_cache) == len(
-        {(s,) for s in signatures}
-    ) or len(trainer._step_cache) > 1
+    assert len(trainer._step_cache) >= len(signatures)

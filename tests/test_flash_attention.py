@@ -1,0 +1,115 @@
+"""Flash-attention kernel vs plain attention — golden-model equivalence
+(SURVEY.md §4: every fused/native op is validated against a pure
+reimplementation; same pattern as the codec goldens)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bagua_tpu.ops.flash_attention import (
+    flash_attention,
+    reference_attention,
+)
+
+
+def _qkv(key, b=2, s=256, h=2, d=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, s, h, d)
+    return (
+        jax.random.normal(kq, shape, dtype),
+        jax.random.normal(kk, shape, dtype),
+        jax.random.normal(kv, shape, dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    want = reference_attention(q, k, v, jnp.float32, causal=causal)
+    got = flash_attention(q, k, v, jnp.float32, causal=causal,
+                          interpret=True, force=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_forward_rectangular_blocks():
+    # seq 384 picks a single 384 block: one grid step, diagonal-only
+    q, k, v = _qkv(jax.random.PRNGKey(3), s=384)
+    want = reference_attention(q, k, v, jnp.float32)
+    got = flash_attention(q, k, v, jnp.float32, interpret=True, force=True)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("block_q,block_k", [(128, 256), (256, 128)])
+def test_mismatched_blocks_fwd_and_bwd(block_q, block_k):
+    # block_q != block_k exercises the causal loop bounds (n_kb ceil-div) and
+    # the dkv kernel's qb_start floor-div with multi-block diagonals
+    q, k, v = _qkv(jax.random.PRNGKey(7), b=1, s=512, h=1, d=64)
+    g = jax.random.normal(jax.random.PRNGKey(8), q.shape, jnp.float32)
+
+    def loss(fn):
+        return jax.grad(lambda q, k, v: (fn(q, k, v) * g).sum(),
+                        argnums=(0, 1, 2))
+
+    ref_fn = lambda q, k, v: reference_attention(q, k, v, jnp.float32)
+    fl_fn = lambda q, k, v: flash_attention(
+        q, k, v, jnp.float32, block_q=block_q, block_k=block_k,
+        interpret=True, force=True,
+    )
+    np.testing.assert_allclose(fl_fn(q, k, v), ref_fn(q, k, v),
+                               atol=2e-5, rtol=2e-5)
+    for w, o, name in zip(loss(ref_fn)(q, k, v), loss(fl_fn)(q, k, v), "qkv"):
+        np.testing.assert_allclose(o, w, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_reference(causal):
+    q, k, v = _qkv(jax.random.PRNGKey(1), b=1, s=256, h=2, d=64)
+    g = jax.random.normal(jax.random.PRNGKey(2), q.shape, jnp.float32)
+
+    def loss(fn):
+        def f(q, k, v):
+            return (fn(q, k, v) * g).sum()
+
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    want = loss(
+        lambda q, k, v: reference_attention(q, k, v, jnp.float32,
+                                            causal=causal)
+    )(q, k, v)
+    got = loss(
+        lambda q, k, v: flash_attention(q, k, v, jnp.float32, causal=causal,
+                                        interpret=True, force=True)
+    )(q, k, v)
+    for w, o, name in zip(want, got, "qkv"):
+        np.testing.assert_allclose(o, w, atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_bf16_forward_close():
+    q, k, v = _qkv(jax.random.PRNGKey(4), dtype=jnp.bfloat16)
+    want = reference_attention(q, k, v, jnp.bfloat16)
+    got = flash_attention(q, k, v, jnp.bfloat16, interpret=True, force=True)
+    np.testing.assert_allclose(
+        got.astype(jnp.float32), want.astype(jnp.float32), atol=2e-2,
+        rtol=2e-2,
+    )
+
+
+def test_cpu_fallback_is_reference():
+    # on CPU (no force) the dispatcher must return the plain path
+    q, k, v = _qkv(jax.random.PRNGKey(5), s=96)
+    want = reference_attention(q, k, v, jnp.float32)
+    got = flash_attention(q, k, v, jnp.float32)
+    np.testing.assert_allclose(got, want, atol=0, rtol=0)
+
+
+def test_model_dispatch_unchanged_on_cpu():
+    # causal_attention (the model hot path) must equal the old jnp math
+    from bagua_tpu.models.transformer import causal_attention
+
+    q, k, v = _qkv(jax.random.PRNGKey(6), s=128)
+    want = reference_attention(q, k, v, jnp.float32)
+    got = causal_attention(q, k, v, jnp.float32)
+    np.testing.assert_allclose(got, want, atol=1e-6, rtol=1e-6)
