@@ -53,6 +53,27 @@ def top1_gating(
     return dispatch, combine, l_aux
 
 
+def topk_routing(
+    logits: jax.Array, k: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dropless top-k routing: no capacity, no dispatch tensor.
+
+    Returns (expert_idx [tokens, k], gate_weights [tokens, k], l_aux) with
+    the same gate conventions as the capacity gates: top-1 keeps the raw
+    chosen probability, top-k>1 renormalizes over the winners; the aux loss
+    is computed over the top-1 assignment (GShard eq. 4).  Consumed by the
+    sort + grouped-matmul (``bagua_tpu.ops.gmm``) dropless MoE path.
+    """
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    n_experts = probs.shape[-1]
+    gates, eidx = jax.lax.top_k(probs, k)
+    mask1 = jax.nn.one_hot(eidx[:, 0], n_experts, dtype=jnp.float32)
+    l_aux = _load_balancing_loss(probs, mask1)
+    if k > 1:
+        gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    return eidx.astype(jnp.int32), gates, l_aux
+
+
 def top2_gating(
     logits: jax.Array, capacity: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:

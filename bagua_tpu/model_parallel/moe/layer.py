@@ -46,6 +46,11 @@ class MoEMLP(nn.Module):
     axis_name: str = "ep"
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    #: capacity-free routing: no token is ever dropped.  Tokens are sorted by
+    #: expert and run through the grouped-matmul Pallas kernel
+    #: (:mod:`bagua_tpu.ops.gmm`) instead of the dense [T,E,C] dispatch
+    #: einsum.  Single-shard (``ep_size == 1``) only for now.
+    dropless: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -61,6 +66,26 @@ class MoEMLP(nn.Module):
             self.n_experts, use_bias=False, dtype=jnp.float32,
             param_dtype=jnp.float32, name="router",
         )(xt.astype(jnp.float32))
+
+        # one definition of the expert weights for both routing paths
+        # (dropless forces ep_size == 1, so n_local == n_experts there)
+        wi = self.param(
+            "expert_wi", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (n_local, d, self.d_ff), self.param_dtype,
+        )
+        wo = self.param(
+            "expert_wo", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (n_local, self.d_ff, d), self.param_dtype,
+        )
+
+        if self.dropless:
+            if self.ep_size > 1:
+                raise NotImplementedError(
+                    "dropless MoE is single-shard (ep_size == 1) for now; "
+                    "use the capacity path for expert parallelism"
+                )
+            return self._dropless(xt, logits, wi, wo).reshape(b, s, d)
+
         capacity = max(1, math.ceil(self.k * tokens * self.capacity_factor
                                     / self.n_experts))
         gate = top1_gating if self.k == 1 else top2_gating
@@ -84,14 +109,6 @@ class MoEMLP(nn.Module):
             # init path (outside shard_map): only shapes matter
             expert_in = expert_in[:n_local]
 
-        wi = self.param(
-            "expert_wi", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (n_local, d, self.d_ff), self.param_dtype,
-        )
-        wo = self.param(
-            "expert_wo", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (n_local, self.d_ff, d), self.param_dtype,
-        )
         h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(self.dtype)))
         out = jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
 
@@ -106,6 +123,29 @@ class MoEMLP(nn.Module):
 
         y = jnp.einsum("tec,ecd->td", combine.astype(self.dtype), out)
         return y.reshape(b, s, d)
+
+    def _dropless(self, xt, logits, wi, wo):
+        """Sort-by-expert + grouped matmul: every routed (token, expert)
+        pair is computed — the capacity-overflow drops of the GShard path
+        (sharded_moe.py:93-238) cannot happen."""
+        from ...ops.gmm import gmm
+        from .gating import topk_routing
+
+        eidx, gates, l_aux = topk_routing(logits, self.k)
+        self.sow("intermediates", "l_aux", l_aux)
+
+        flat_e = eidx.reshape(-1)                       # [T*k]
+        order = jnp.argsort(flat_e)                     # stable: ties by token
+        token_of_row = order // self.k
+        x_rows = xt[token_of_row].astype(self.dtype)    # [T*k, d] grouped
+        sizes = jnp.bincount(flat_e, length=self.n_experts)
+
+        h = nn.silu(gmm(x_rows, wi.astype(self.dtype), sizes))
+        y_rows = gmm(h, wo.astype(self.dtype), sizes)   # [T*k, d]
+
+        w = gates.reshape(-1)[order].astype(self.dtype)
+        y = jnp.zeros((xt.shape[0], xt.shape[1]), self.dtype)
+        return y.at[token_of_row].add(y_rows * w[:, None])
 
 
 # The exact parameter names MoEMLP creates.  Marking is by path *segment*

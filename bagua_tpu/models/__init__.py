@@ -1,1 +1,2 @@
 from .mlp import MLP  # noqa: F401
+from .vgg import VGG, VGG16, VGG19, vgg_loss_fn  # noqa: F401

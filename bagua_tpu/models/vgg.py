@@ -1,0 +1,70 @@
+"""VGG family — the reference's headline end-to-end benchmark model.
+
+Bagua's flagship published number is VGG16 synthetic-ImageNet throughput
+(/root/reference/rust/bagua-net/README.md:65-81: 126.5 img/s per V100 with
+bagua-net, 85.8 baseline; README.md:21-26 is the 128-GPU VGG16 scaling
+chart; the autotune sysperf probe also trains VGG16,
+/root/reference/bagua/service/autotune_system.py).  TPU-first rendering:
+bfloat16 convs on the MXU, NHWC layout, f32 params, static shapes; the
+classifier head keeps the original two 4096-wide dense layers — on TPU
+those are the cheap part (dense matmuls), the conv stack is the work.
+Classifier dropout is intentionally omitted: the trainer's loss contract is
+rng-free and the synthetic throughput workload (the reference's benchmark
+use of VGG16) measures step time, not generalization.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# filters per conv, "M" = 2x2 max-pool (the standard configuration tables)
+_VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M")
+_VGG19_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Sequence = _VGG16_CFG
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    hidden: int = 4096
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        i = 0
+        for c in self.cfg:
+            if c == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.relu(conv(c, name=f"conv{i}")(x))
+                i += 1
+        x = x.reshape(x.shape[0], -1)
+        dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32)
+        x = nn.relu(dense(self.hidden, name="fc1")(x))
+        x = nn.relu(dense(self.hidden, name="fc2")(x))
+        return dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+VGG16 = partial(VGG, cfg=_VGG16_CFG)
+VGG19 = partial(VGG, cfg=_VGG19_CFG)
+
+
+def vgg_loss_fn(model):
+    """Softmax cross-entropy over integer labels (no batch-norm state)."""
+    import optax
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["images"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["labels"]
+        ).mean()
+
+    return loss_fn

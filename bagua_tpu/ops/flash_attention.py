@@ -341,15 +341,6 @@ def flash_supported(seq: int, head_dim: int, block: int = _LANE) -> bool:
     return 4 * seq * max(head_dim, _LANE) * 2 <= 12 * 1024 * 1024
 
 
-def _pick_block(s: int) -> int:
-    # bigger blocks amortize the inner-loop overhead; bounded by VMEM tiles
-    # (384 serves seq lengths like 1152/1920 that 512/256 don't divide)
-    for blk in (512, 384, 256, _LANE):
-        if s % blk == 0:
-            return blk
-    return _LANE
-
-
 def flash_attention(q, k, v, dtype=None, *, causal: bool = True,
                     block_q: int = 0, block_k: int = 0,
                     interpret: bool = False, force: bool = False):
@@ -360,10 +351,12 @@ def flash_attention(q, k, v, dtype=None, *, causal: bool = True,
     ``force`` skips the platform check (tests run the kernel in interpret
     mode on CPU).
     """
+    from .tiles import pick_block
+
     b, s, h, d = q.shape
     dtype = dtype or q.dtype
-    block_q = block_q or _pick_block(s)
-    block_k = block_k or _pick_block(s)
+    block_q = block_q or pick_block(s)
+    block_k = block_k or pick_block(s)
     if not force and not flash_supported(s, d, max(block_q, block_k)):
         return reference_attention(q, k, v, dtype, causal=causal)
     if s % block_q or s % block_k:

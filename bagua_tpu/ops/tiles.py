@@ -1,0 +1,15 @@
+"""Shared TPU tile-size helpers for the Pallas kernels."""
+
+LANE = 128
+
+_CANDIDATES = (512, 384, 256, LANE)
+
+
+def pick_block(dim: int, cap: int = 512) -> int:
+    """Largest 128-multiple divisor of ``dim`` from the candidate set, not
+    exceeding ``cap`` — bigger blocks amortize per-iteration kernel overhead
+    while staying inside VMEM tiles."""
+    for c in _CANDIDATES:
+        if c <= cap and dim % c == 0:
+            return c
+    return LANE

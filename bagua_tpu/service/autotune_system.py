@@ -85,6 +85,8 @@ def sysperf(args) -> int:
     from concurrent.futures import ThreadPoolExecutor
 
     hosts = [h.strip() for h in args.host_list.split(",") if h.strip()]
+    if not hosts:
+        return 0
     # probe all hosts concurrently (the reference fans out with parallel-ssh;
     # serial probing would serialize per-host timeouts on a hung fleet)
     with ThreadPoolExecutor(max_workers=min(len(hosts), 64)) as pool:

@@ -187,6 +187,39 @@ def bench_bert(mesh, n_dev: int) -> dict:
     }
 
 
+VGG16_HEADLINE_FLOOR = 126.5  # img/s per V100, bagua + bagua-net
+# (/root/reference/rust/bagua-net/README.md:65-66 — the headline benchmark)
+
+
+def bench_vgg16(mesh, n_dev: int) -> dict:
+    """The reference's flagship number: VGG16 synthetic ImageNet throughput
+    (bagua-net/README.md:48-81, 4x8 V100 over 100 GbE)."""
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.models.vgg import VGG16, vgg_loss_fn
+
+    model = VGG16(num_classes=1000)
+    batch = BATCH_PER_DEVICE * n_dev
+    images = jnp.zeros((batch, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
+    labels = jnp.zeros((batch,), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), images[:2])["params"]
+    trainer = BaguaTrainer(
+        vgg_loss_fn(model), optax.sgd(0.1, momentum=0.9),
+        GradientAllReduceAlgorithm(hierarchical=False), mesh=mesh,
+        autotune=False,
+    )
+    state = trainer.init(params)
+    data = trainer.shard_batch({"images": images, "labels": labels})
+    dt, _, _ = _time_steps(trainer, state, data)
+    per_device = TIMED_STEPS * batch / dt / n_dev
+    return {
+        "metric": "vgg16_gradient_allreduce_imgs_per_sec_per_chip",
+        "value": round(per_device, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_device / VGG16_HEADLINE_FLOOR, 3),
+    }
+
+
 def bench_longctx(mesh, n_dev: int) -> dict:
     """Long-context LM throughput — the flash-attention (Pallas) hot path.
     ``vs_baseline`` is the speedup over the same model with the plain
@@ -293,6 +326,7 @@ def main():
         records = []
         for family, factory in _algorithms().items():
             records.append(_emit(bench_family(family, factory, mesh, n_dev)))
+        records.append(_emit(bench_vgg16(mesh, n_dev)))
         records.append(_emit(bench_moe(mesh, n_dev)))
         records.append(_emit(bench_bert(mesh, n_dev)))
         records.append(_emit(bench_longctx(mesh, n_dev)))

@@ -86,3 +86,26 @@ def test_resnet_trains():
                                                  "labels": labels})
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_vgg_trains():
+    from bagua_tpu.models.vgg import VGG, vgg_loss_fn
+
+    # tiny VGG: two conv stages, small head
+    model = VGG(cfg=(8, "M", 16, "M"), num_classes=4, hidden=32,
+                dtype=jnp.float32)
+    mesh = build_mesh({"dp": N_DEVICES})
+    images = jax.random.normal(jax.random.PRNGKey(0), (N_DEVICES * 2, 16, 16, 3))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (N_DEVICES * 2,), 0, 4)
+    params = model.init(jax.random.PRNGKey(2), images[:2])["params"]
+    trainer = BaguaTrainer(
+        vgg_loss_fn(model), optax.sgd(0.05), GradientAllReduceAlgorithm(),
+        mesh=mesh,
+    )
+    state = trainer.init(params)
+    losses = []
+    for _ in range(5):
+        state, loss = trainer.train_step(state, {"images": images,
+                                                 "labels": labels})
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]

@@ -1,0 +1,103 @@
+"""Dropless (capacity-free) MoE vs dense per-expert reference and vs the
+capacity path at infinite capacity — golden-model pattern (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu.model_parallel.moe.layer import MoEMLP
+
+
+def _dense_reference(params, x, k, dtype=jnp.float32):
+    """Every token through its top-k experts, computed expert-by-expert."""
+    from bagua_tpu.model_parallel.moe.gating import topk_routing
+
+    b, s, d = x.shape
+    xt = x.reshape(-1, d)
+    router = params["router"]["kernel"]
+    logits = xt.astype(jnp.float32) @ router
+    eidx, gates, _ = topk_routing(logits, k)
+    wi, wo = params["expert_wi"], params["expert_wo"]
+
+    def expert(e, t):
+        h = jax.nn.silu(xt[t] @ wi[e])
+        return h @ wo[e]
+
+    out = jnp.zeros_like(xt)
+    for t in range(xt.shape[0]):
+        for j in range(k):
+            e = int(eidx[t, j])
+            out = out.at[t].add(gates[t, j] * expert(e, t))
+    return out.reshape(b, s, d)
+
+
+def test_dropless_matches_dense_reference():
+    layer = MoEMLP(n_experts=4, d_ff=32, k=2, dropless=True,
+                   dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    got = layer.apply({"params": params}, x)
+    want = _dense_reference(params, x, k=2)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_dropless_equals_capacity_path_at_infinite_capacity(k):
+    # with capacity >= tokens nothing is dropped, so both paths compute the
+    # same math (same gate conventions by design)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 16))
+    tokens = x.shape[0] * x.shape[1]
+    drop = MoEMLP(n_experts=4, d_ff=32, k=k, dropless=True,
+                  dtype=jnp.float32)
+    cap = MoEMLP(n_experts=4, d_ff=32, k=k, dropless=False,
+                 capacity_factor=float(tokens), dtype=jnp.float32)
+    params = drop.init(jax.random.PRNGKey(3), x)["params"]
+    got = drop.apply({"params": params}, x)
+    want = cap.apply({"params": params}, x)
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_dropless_never_drops_under_skew():
+    # route everything to one expert: capacity path drops, dropless doesn't
+    layer = MoEMLP(n_experts=4, d_ff=32, k=1, dropless=True,
+                   dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, 16, 16))
+    params = layer.init(jax.random.PRNGKey(5), x)["params"]
+    # bias the router so expert 2 wins for every token
+    router = jnp.zeros_like(params["router"]["kernel"]).at[:, 2].set(10.0)
+    params = {**params, "router": {"kernel": router}}
+    out = layer.apply({"params": params}, x)
+    want = _dense_reference(params, x, k=1)
+    np.testing.assert_allclose(out, want, atol=1e-4, rtol=1e-4)
+    assert float(jnp.abs(out).sum()) > 0
+
+
+def test_dropless_trains():
+    layer = MoEMLP(n_experts=4, d_ff=32, k=2, dropless=True,
+                   dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(6), (4, 8, 16))
+    y = jax.random.normal(jax.random.PRNGKey(7), (4, 8, 16))
+    params = layer.init(jax.random.PRNGKey(8), x)["params"]
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            out, mut = MoEMLP(
+                n_experts=4, d_ff=32, k=2, dropless=True, dtype=jnp.float32
+            ).apply({"params": p}, x, mutable=["intermediates"])
+            aux = sum(jax.tree.leaves(mut["intermediates"]))
+            return ((out - y) ** 2).mean() + 0.01 * aux.sum()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], losses[:3] + losses[-3:]
