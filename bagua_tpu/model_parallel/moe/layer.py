@@ -49,8 +49,16 @@ class MoEMLP(nn.Module):
     #: capacity-free routing: no token is ever dropped.  Tokens are sorted by
     #: expert and run through the grouped-matmul Pallas kernel
     #: (:mod:`bagua_tpu.ops.gmm`) instead of the dense [T,E,C] dispatch
-    #: einsum.  Single-shard (``ep_size == 1``) only for now.
+    #: einsum.  With ``ep_size > 1`` the inter-shard exchange is a ragged
+    #: all-to-all with exact per-destination counts (the reference's
+    #: ``alltoall_v``, communicators/mod.rs:632-676) instead of dense
+    #: capacity slots.
     dropless: bool = False
+    #: dropless EP transfer via ``lax.ragged_all_to_all`` (exact counts on
+    #: the wire).  Off by default: XLA:CPU cannot execute the ragged HLO, so
+    #: the virtual-mesh test/dryrun environments use the dense-slot
+    #: ``all_to_all`` path; enable on real multi-chip TPU meshes.
+    use_ragged: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -67,8 +75,8 @@ class MoEMLP(nn.Module):
             param_dtype=jnp.float32, name="router",
         )(xt.astype(jnp.float32))
 
-        # one definition of the expert weights for both routing paths
-        # (dropless forces ep_size == 1, so n_local == n_experts there)
+        # one definition of the expert weights for both routing paths —
+        # always the LOCAL table [n_experts // ep_size, ...]
         wi = self.param(
             "expert_wi", nn.initializers.lecun_normal(batch_axis=(0,)),
             (n_local, d, self.d_ff), self.param_dtype,
@@ -79,11 +87,6 @@ class MoEMLP(nn.Module):
         )
 
         if self.dropless:
-            if self.ep_size > 1:
-                raise NotImplementedError(
-                    "dropless MoE is single-shard (ep_size == 1) for now; "
-                    "use the capacity path for expert parallelism"
-                )
             return self._dropless(xt, logits, wi, wo).reshape(b, s, d)
 
         capacity = max(1, math.ceil(self.k * tokens * self.capacity_factor
@@ -127,10 +130,19 @@ class MoEMLP(nn.Module):
     def _dropless(self, xt, logits, wi, wo):
         """Sort-by-expert + grouped matmul: every routed (token, expert)
         pair is computed — the capacity-overflow drops of the GShard path
-        (sharded_moe.py:93-238) cannot happen."""
+        (sharded_moe.py:93-238) cannot happen.
+
+        With expert parallelism the exchange is a ragged all-to-all with
+        exact counts: rows sorted by global expert are already grouped by
+        owning shard, so shard p receives only the rows routed to its
+        experts (worst-case receive buffer: every peer routes all its rows
+        here).  Expert outputs ride the symmetric reverse transfer back to
+        their source rows, and gates are applied at the source.
+        """
         from ...ops.gmm import gmm
         from .gating import topk_routing
 
+        n_local = self.n_experts // self.ep_size
         eidx, gates, l_aux = topk_routing(logits, self.k)
         self.sow("intermediates", "l_aux", l_aux)
 
@@ -138,14 +150,116 @@ class MoEMLP(nn.Module):
         order = jnp.argsort(flat_e)                     # stable: ties by token
         token_of_row = order // self.k
         x_rows = xt[token_of_row].astype(self.dtype)    # [T*k, d] grouped
-        sizes = jnp.bincount(flat_e, length=self.n_experts)
+        e_rows = flat_e[order]
 
-        h = nn.silu(gmm(x_rows, wi.astype(self.dtype), sizes))
-        y_rows = gmm(h, wo.astype(self.dtype), sizes)   # [T*k, d]
+        inside_mesh = self.ep_size > 1 and _axis_bound(self.axis_name)
+        if inside_mesh:
+            y_rows = self._dropless_exchange(x_rows, e_rows, wi, wo, n_local)
+        else:
+            # single shard — or the init trace outside shard_map, where only
+            # shapes matter: fold global expert ids onto the local table
+            eid = e_rows if self.ep_size == 1 else e_rows % n_local
+            sizes = jnp.bincount(eid, length=n_local)
+            local_order = jnp.argsort(eid) if self.ep_size > 1 else None
+            rows = x_rows if local_order is None else x_rows[local_order]
+            h = nn.silu(gmm(rows, wi.astype(self.dtype), sizes))
+            y = gmm(h, wo.astype(self.dtype), sizes)
+            if local_order is None:
+                y_rows = y
+            else:
+                y_rows = jnp.zeros_like(y).at[local_order].set(y)
 
         w = gates.reshape(-1)[order].astype(self.dtype)
-        y = jnp.zeros((xt.shape[0], xt.shape[1]), self.dtype)
-        return y.at[token_of_row].add(y_rows * w[:, None])
+        out = jnp.zeros((xt.shape[0], xt.shape[1]), self.dtype)
+        return out.at[token_of_row].add(y_rows * w[:, None])
+
+    def _dropless_exchange(self, x_rows, e_rows, wi, wo, n_local):
+        """EP dispatch for dropless routing: [T*k, d] rows grouped by global
+        expert → owning shards → local grouped matmul → reverse transfer.
+
+        The analog of the reference's ``alltoall_v``-driven MoE all-to-all
+        (communicators/mod.rs:632-676, sharded_moe.py:77-90).  Rows for peer
+        ``p`` occupy the fixed slot range ``[p*tk, p*tk + count_p)`` of a
+        worst-case send buffer, so the transfer is one dense ``all_to_all``
+        (validatable on the virtual CPU mesh) and every downstream index is
+        slot-deterministic.  ``use_ragged=True`` swaps in
+        ``lax.ragged_all_to_all`` with exact counts over the same slot
+        layout — moving only the routed bytes on ICI — but XLA:CPU has no
+        ragged-all-to-all kernel, so it stays opt-in for real TPU meshes.
+        """
+        ep, ax = self.ep_size, self.axis_name
+        tk, d = x_rows.shape
+        cap = ep * tk                                   # worst-case slots
+
+        # per-destination counts (rows sorted by global expert are already
+        # grouped by owning shard); the [ep, n_local] counts exchange lets
+        # the receiver reconstruct every row's local expert id from the
+        # deterministic slot layout — no per-row metadata on the wire
+        sizes_global = jnp.bincount(e_rows, length=self.n_experts)
+        counts = sizes_global.reshape(ep, n_local).astype(jnp.int32)
+        send_sizes = counts.sum(-1)
+        input_offsets = (jnp.cumsum(send_sizes) - send_sizes).astype(jnp.int32)
+        r = jnp.arange(tk, dtype=jnp.int32)
+        peer_of_row = jnp.searchsorted(
+            jnp.cumsum(send_sizes), r, side="right"
+        ).astype(jnp.int32)
+        slot = peer_of_row * tk + (r - input_offsets[peer_of_row])
+
+        # counts_recv[p, e] = rows peer p routed to my local expert e
+        counts_recv = lax.all_to_all(counts, ax, 0, 0, tiled=False).reshape(
+            ep, n_local
+        )
+        # rows from peer p occupy slots [p*tk, p*tk + Σe counts_recv[p])
+        # ordered by local expert; beyond that the slot is empty (sentinel
+        # id n_local, zero payload)
+        cums = jnp.cumsum(counts_recv, axis=1)          # [ep, n_local]
+        within = jnp.arange(tk, dtype=jnp.int32)
+        lid_recv = (
+            (within[None, :, None] >= cums[:, None, :]).sum(-1)
+            .astype(jnp.int32).reshape(cap)
+        )
+        sizes = counts_recv.sum(0)                      # rows per local expert
+
+        if self.use_ragged:
+            my = lax.axis_index(ax)
+            recv_sizes = counts_recv.sum(-1)
+            out_offs = jnp.full((ep,), my * tk, jnp.int32)
+            x_recv = lax.ragged_all_to_all(
+                x_rows, jnp.zeros((cap, d), x_rows.dtype),
+                input_offsets, send_sizes, out_offs, recv_sizes,
+                axis_name=ax,
+            )
+        else:
+            x_send = jnp.zeros((cap, d), x_rows.dtype).at[slot].set(x_rows)
+            x_recv = lax.all_to_all(
+                x_send.reshape(ep, tk, d), ax, 0, 0, tiled=False
+            ).reshape(cap, d)
+
+        # group received rows by local expert; sentinel (empty-slot) rows
+        # sort last, fall outside the grouped range, and are zero
+        local_order = jnp.argsort(lid_recv)
+        rows = x_recv[local_order]
+        from ...ops.gmm import gmm
+
+        h = nn.silu(gmm(rows, wi.astype(self.dtype), sizes))
+        y_sorted = gmm(h, wo.astype(self.dtype), sizes)
+        y_local = jnp.zeros_like(y_sorted).at[local_order].set(y_sorted)
+
+        # reverse transfer over the same slots, then gather my rows back
+        if self.use_ragged:
+            peer_in_offsets = lax.all_to_all(
+                input_offsets, ax, 0, 0, tiled=False
+            ).reshape(ep)
+            rev_in_offsets = jnp.arange(ep, dtype=jnp.int32) * tk
+            return lax.ragged_all_to_all(
+                y_local, jnp.zeros((tk, d), y_local.dtype),
+                rev_in_offsets, recv_sizes, peer_in_offsets, send_sizes,
+                axis_name=ax,
+            )
+        y_back = lax.all_to_all(
+            y_local.reshape(ep, tk, d), ax, 0, 0, tiled=False
+        ).reshape(cap, d)
+        return y_back[slot]
 
 
 # The exact parameter names MoEMLP creates.  Marking is by path *segment*

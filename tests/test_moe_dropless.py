@@ -101,3 +101,95 @@ def test_dropless_trains():
         params, opt_state, loss = step(params, opt_state)
         losses.append(float(loss))
     assert losses[-1] < 0.5 * losses[0], losses[:3] + losses[-3:]
+
+
+# ---------------------------------------------------------------------------
+# expert-parallel dropless: ragged all-to-all dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_dropless_ep_matches_single_shard_forward():
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    E, ep, d_model, d_ff, seq = 8, 4, 16, 32, 8
+    single = MoEMLP(n_experts=E, d_ff=d_ff, ep_size=1, k=2, dropless=True,
+                    dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, seq, d_model))
+    params = single.init(jax.random.PRNGKey(1), x[:2])["params"]
+    ref = single.apply({"params": params}, x)
+
+    sharded = MoEMLP(n_experts=E, d_ff=d_ff, ep_size=ep, k=2, dropless=True,
+                     dtype=jnp.float32)
+    mesh = build_mesh({"ep": ep}, jax.devices()[:ep])
+    pspec = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (
+            P("ep") if "expert" in jax.tree_util.keystr(path) else P()
+        ),
+        params,
+    )
+    out = jax.jit(shard_map(
+        lambda p, xs: sharded.apply({"params": p}, xs),
+        mesh=mesh, in_specs=(pspec, P("ep")), out_specs=P("ep"),
+        check_vma=False,
+    ))(params, x)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_dropless_ep_one_step_matches_single_shard():
+    """One SGD step through the trainer: ep=4 must yield the same updated
+    weights as single-shard dropless (validates the ragged-exchange grads
+    and the trainer's 1/ep expert-grad rescale)."""
+    from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.model_parallel.moe import moe_lm_loss_fn
+    from bagua_tpu.models.transformer import TransformerConfig, TransformerLM
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    E, ep, lr = 4, 4, 0.1
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                            d_ff=64, max_seq_len=8, dtype=jnp.float32)
+
+    def make_model(ep_size):
+        return TransformerLM(cfg, mlp_factory=lambda i: (
+            lambda: MoEMLP(n_experts=E, d_ff=64, k=2, ep_size=ep_size,
+                           dropless=True, dtype=jnp.float32)
+        ) if i == 1 else None)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, cfg.max_seq_len + 1),
+                                0, cfg.vocab_size)
+    params = make_model(1).init(jax.random.PRNGKey(1), tokens[:2, :-1])["params"]
+
+    # aux_loss_weight=0: the load-balancing aux is nonlinear in the
+    # batch, so sharding the batch over ep legitimately changes it —
+    # this test isolates the routing/compute/grad path
+    t1 = BaguaTrainer(moe_lm_loss_fn(make_model(1), aux_loss_weight=0.0),
+                      optax.sgd(lr),
+                      GradientAllReduceAlgorithm(),
+                      mesh=build_mesh({"dp": 1}, jax.devices()[:1]),
+                      autotune=False)
+    s1 = t1.init(params)
+    s1, loss1 = t1.train_step(s1, t1.shard_batch({"tokens": tokens}))
+
+    tep = BaguaTrainer(moe_lm_loss_fn(make_model(ep), aux_loss_weight=0.0),
+                       optax.sgd(lr),
+                       GradientAllReduceAlgorithm(),
+                       mesh=build_mesh({"dp": 1, "ep": ep},
+                                       jax.devices()[:ep]),
+                       expert_axis="ep", autotune=False)
+    sep = tep.init(params)
+    sep, lossep = tep.train_step(sep, tep.shard_batch({"tokens": tokens}))
+
+    np.testing.assert_allclose(float(loss1), float(lossep), atol=1e-5)
+    w1 = t1.unstack_params(s1)
+    wep = tep.unstack_params(sep)
+    flat1 = jax.tree_util.tree_leaves_with_path(w1)
+    flatep = dict(jax.tree_util.tree_leaves_with_path(wep))
+    for path, leaf in flat1:
+        got = flatep[path]
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(got), atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
