@@ -98,6 +98,10 @@ class BaguaCommunicator:
 
     def allreduce(self, x, op: ReduceOp = ReduceOp.AVG):
         ax = self.axes
+        if not ax:
+            # zero-axis communicator (e.g. a tp-only mesh has no data axes):
+            # every reduction is an identity over a single member
+            return x
         if op == ReduceOp.SUM:
             return lax.psum(x, ax)
         if op == ReduceOp.AVG:

@@ -77,6 +77,8 @@ class BaguaTrainer:
         expert_params=None,
         expert_keyword: Optional[str] = None,
         seq_axis: Optional[str] = None,
+        tp_axis: Optional[str] = None,
+        tp_param_dim=None,
     ):
         """``expert_axis``: mesh axis carrying expert parallelism (MoE).
         Expert params are sharded over it and excluded from the data-parallel
@@ -93,7 +95,17 @@ class BaguaTrainer:
         slices its own sequence chunk, see ``sp_lm_loss_fn``) while gradient
         communication spans it: each shard's grads cover only its chunk's
         contribution, so dp-style averaging over dp × sp restores the full
-        gradient."""
+        gradient.
+
+        ``tp_axis``: mesh axis carrying tensor parallelism (Megatron-style;
+        see ``parallel/tensor_parallel.py``).  ``tp_param_dim`` maps a param
+        name to the dimension of its GLOBAL array sharded over ``tp_axis``
+        (None for replicated params); default: the transformer family's
+        ``models.transformer.tp_param_dim``.  TP leaves are excluded from
+        the data-parallel bucket plan (each shard owns its slice; grads need
+        averaging over dp only), while dense-leaf grads are exact and
+        identical across tp thanks to the model's conjugate collectives —
+        so the bucket allreduce deliberately does NOT span tp."""
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.algorithm = algorithm
@@ -110,12 +122,29 @@ class BaguaTrainer:
         self.mesh = mesh
         # fail fast on typo'd axis names: silently nulling them would include
         # expert params in the dense DP plan and corrupt MoE training
-        for label, ax in (("expert_axis", expert_axis), ("seq_axis", seq_axis)):
+        for label, ax in (("expert_axis", expert_axis), ("seq_axis", seq_axis),
+                          ("tp_axis", tp_axis)):
             if ax is not None and ax not in mesh.axis_names:
                 raise ValueError(
                     f"{label}={ax!r} is not a mesh axis "
                     f"(mesh axes: {mesh.axis_names})"
                 )
+        if tp_axis is not None:
+            if expert_axis is not None:
+                raise NotImplementedError(
+                    "combining tp_axis with expert_axis is not supported yet"
+                )
+            if not algorithm.replicated_params:
+                raise NotImplementedError(
+                    "tensor parallelism requires a replicated-params "
+                    "algorithm (gossip state is per-rank)"
+                )
+        self.tp_axis = tp_axis
+        if tp_param_dim is None and tp_axis is not None:
+            from ..models.transformer import tp_param_dim as _default_tp_dim
+
+            tp_param_dim = _default_tp_dim
+        self._tp_param_dim = tp_param_dim
         self.expert_axis = expert_axis
         self._expert_filter = self._make_expert_filter(expert_params, expert_keyword)
         self.seq_axis = seq_axis
@@ -123,9 +152,14 @@ class BaguaTrainer:
             dp_axes = tuple(
                 a for a in mesh.axis_names
                 if a in ("dp", "inter", "intra")
-                and a not in (self.expert_axis, self.seq_axis)
+                and a not in (self.expert_axis, self.seq_axis, self.tp_axis)
             )
-            if not dp_axes and self.expert_axis is None and self.seq_axis is None:
+            if (
+                not dp_axes
+                and self.expert_axis is None
+                and self.seq_axis is None
+                and self.tp_axis is None
+            ):
                 dp_axes = (mesh.axis_names[0],)
         self.dp_axes = tuple(dp_axes)
         if (
@@ -208,15 +242,44 @@ class BaguaTrainer:
     def _is_expert_name(self, name: str) -> bool:
         return self.expert_axis is not None and self._expert_filter(name)
 
+    def _tp_dim(self, name: str) -> Optional[int]:
+        if self.tp_axis is None or self._tp_param_dim is None:
+            return None
+        return self._tp_param_dim(name)
+
     def _build_plan(self, params) -> BucketPlan:
         candidates = [
-            p for p in build_params(params) if not self._is_expert_name(p.name)
+            p for p in build_params(params)
+            if not self._is_expert_name(p.name) and self._tp_dim(p.name) is None
         ]
         named = self.algorithm.init_tensors(candidates)
         self._named_params = named
         decls = [p.declaration() for p in named]
         decl_buckets = split_bucket_by_bucket_size(decls, self.bucket_bytes)
         return self.algorithm.tensors_to_buckets(decl_buckets, named, self.world_size)
+
+    def _tp_param_spec_tree(self, params):
+        """Per-leaf PartitionSpecs: tp leaves sharded along their reported
+        dim, everything else replicated."""
+        def leaf_spec(path, leaf):
+            dim = self._tp_dim(_name_of_path(path))
+            if dim is None:
+                return P()
+            return P(*([None] * dim + [self.tp_axis]))
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+    def _tp_match_spec_tree(self, tree, sharded_by_name):
+        """Specs for a param-mirroring tree (optimizer state): a leaf whose
+        dotted path ends with a tp param's full name inherits its spec."""
+        def leaf_spec(path, leaf):
+            name = _name_of_path(path)
+            for pn, spec in sharded_by_name.items():
+                if name == pn or name.endswith("." + pn):
+                    return spec
+            return P()
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, tree)
 
     def rebucket(self, decl_buckets) -> None:
         """Apply an autotune bucketing suggestion (reference
@@ -281,6 +344,19 @@ class BaguaTrainer:
                 shard_map(init_fn, mesh=mesh, in_specs=(P(),), out_specs=P(),
                           check_vma=False)
             )(params)
+            if self.tp_axis is not None:
+                if algo_state is not None:
+                    raise NotImplementedError(
+                        "tensor parallelism with stateful algorithms "
+                        "(QAdam-style) is not supported yet"
+                    )
+                self._param_specs = self._tp_param_spec_tree(params)
+                sharded = {}
+                flat = jax.tree_util.tree_flatten_with_path(self._param_specs)[0]
+                for path, spec in flat:
+                    if spec != P():
+                        sharded[_name_of_path(path)] = spec
+                self._opt_specs = self._tp_match_spec_tree(opt_state, sharded)
             return TrainState(jnp.zeros((), jnp.int32), params, opt_state, algo_state)
 
         # per-rank (gossip) state: stack every leaf along a leading rank axis
@@ -349,6 +425,18 @@ class BaguaTrainer:
                     ),
                     grads,
                 )
+            if self.tp_axis is not None:
+                # tp-slice grads bypass the bucket plan: each shard owns its
+                # slice (complete gradient, thanks to the model's conjugate
+                # collectives) — average over the data axes only, no rescale
+                tp_dp = expert_dp
+
+                def tp_grad(path, g):
+                    if self._tp_dim(_name_of_path(path)) is None or not tp_dp:
+                        return g
+                    return jax.lax.pmean(g, tp_dp)
+
+                grads = jax.tree_util.tree_map_with_path(tp_grad, grads)
             params, algo_state = algo.process_pre_step(ctx, params, algo_state, step)
             if algo.owns_optimizer:
                 params, opt_state, algo_state = algo.optimizer_update(
@@ -369,10 +457,18 @@ class BaguaTrainer:
 
         if expert is not None:
             pspec = P((expert,))
+            state_specs = TrainState(step=P(), params=pspec, opt_state=pspec,
+                                     algo_state=pspec)
+        elif self.tp_axis is not None:
+            state_specs = TrainState(
+                step=P(), params=self._param_specs,
+                opt_state=self._opt_specs, algo_state=P(),
+            )
         else:
             pspec = P() if replicated else P(dp)
+            state_specs = TrainState(step=P(), params=pspec, opt_state=pspec,
+                                     algo_state=pspec)
         batch_spec = self._batch_spec()
-        state_specs = TrainState(step=P(), params=pspec, opt_state=pspec, algo_state=pspec)
 
         fn = shard_map(
             per_shard,

@@ -41,6 +41,12 @@ class TransformerConfig:
     #: each shard holds a contiguous sequence chunk and position embeddings
     #: are offset by axis_index * local_len
     sp_axis: Optional[str] = None
+    #: tensor-parallel mesh axis (Megatron-style): attention heads and FFN
+    #: width are sharded tp_size ways; params are LOCAL slices inside the
+    #: step (see parallel/tensor_parallel.py).  n_heads and d_ff must be
+    #: divisible by tp_size.
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
 
     @property
     def head_dim(self) -> int:
@@ -91,6 +97,13 @@ def causal_attention(q, k, v, dtype):
     return flash_attention(q, k, v, dtype, causal=True)
 
 
+def _tp_active(cfg) -> bool:
+    return (
+        cfg.tp_axis is not None and cfg.tp_size > 1
+        and _axis_bound(cfg.tp_axis)
+    )
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
     attn_fn: Optional[Callable] = None
@@ -98,7 +111,12 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h, d = cfg.n_heads, cfg.head_dim
+        assert cfg.n_heads % cfg.tp_size == 0, (cfg.n_heads, cfg.tp_size)
+        h, d = cfg.n_heads // cfg.tp_size, cfg.head_dim  # local heads
+        if _tp_active(cfg):
+            from ..parallel.tensor_parallel import tp_gather_grad
+
+            x = tp_gather_grad(x, cfg.tp_axis)
         dense = lambda name: nn.DenseGeneral(
             (h, d), axis=-1, name=name, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, use_bias=False,
@@ -106,10 +124,15 @@ class Attention(nn.Module):
         q, k, v = dense("q")(x), dense("k")(x), dense("v")(x)
         fn = self.attn_fn or causal_attention
         o = fn(q, k, v, cfg.dtype)
-        return nn.DenseGeneral(
+        out = nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), name="o", dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, use_bias=False,
         )(o)
+        if _tp_active(cfg):
+            from ..parallel.tensor_parallel import tp_reduce
+
+            out = tp_reduce(out, cfg.tp_axis)  # row-parallel partial sums
+        return out
 
 
 class MLPBlock(nn.Module):
@@ -118,13 +141,24 @@ class MLPBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+        assert cfg.d_ff % cfg.tp_size == 0, (cfg.d_ff, cfg.tp_size)
+        d_ff = cfg.d_ff // cfg.tp_size                   # local width
+        if _tp_active(cfg):
+            from ..parallel.tensor_parallel import tp_gather_grad
+
+            x = tp_gather_grad(x, cfg.tp_axis)
+        gate = nn.Dense(d_ff, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="wi_gate")(x)
-        up = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+        up = nn.Dense(d_ff, use_bias=False, dtype=cfg.dtype,
                       param_dtype=cfg.param_dtype, name="wi_up")(x)
         y = nn.silu(gate) * up
-        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype, name="wo")(y)
+        out = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wo")(y)
+        if _tp_active(cfg):
+            from ..parallel.tensor_parallel import tp_reduce
+
+            out = tp_reduce(out, cfg.tp_axis)
+        return out
 
 
 class Block(nn.Module):
@@ -180,6 +214,41 @@ class TransformerLM(nn.Module):
             param_dtype=cfg.param_dtype, name="lm_head",
         )(x)
         return logits.astype(jnp.float32)
+
+
+#: dotted-name suffix -> (sharded dim of the GLOBAL kernel, contracting
+#: dims) for the trainer's tp leaf sharding and the global-init redraw
+#: (column-parallel kernels shard an output feature dim; row-parallel
+#: kernels shard a contracting dim)
+_TP_DIMS = {
+    # q/k/v: [d_model, heads, head_dim] — shard heads, contract d_model
+    "attn.q.kernel": (1, (0,)),
+    "attn.k.kernel": (1, (0,)),
+    "attn.v.kernel": (1, (0,)),
+    # o: [heads, head_dim, d_model] — shard heads, contract heads*head_dim
+    "attn.o.kernel": (0, (0, 1)),
+    # wi: [d_model, d_ff] — shard d_ff, contract d_model
+    "mlp.wi_gate.kernel": (1, (0,)),
+    "mlp.wi_up.kernel": (1, (0,)),
+    # wo: [d_ff, d_model] — shard d_ff, contract d_ff
+    "mlp.wo.kernel": (0, (0,)),
+}
+
+
+def tp_param_dim(name: str):
+    """Sharded dim for a TP param of :class:`TransformerLM` (None: dense)."""
+    for suffix, (dim, _) in _TP_DIMS.items():
+        if name.endswith(suffix):
+            return dim
+    return None
+
+
+def tp_param_fan_in_dims(name: str):
+    """Contracting dims of a TP kernel's GLOBAL shape (for init redraw)."""
+    for suffix, (_, fan_in) in _TP_DIMS.items():
+        if name.endswith(suffix):
+            return fan_in
+    return None
 
 
 def lm_loss_fn(model: TransformerLM):

@@ -1,0 +1,122 @@
+"""Tensor parallelism (Megatron-style) over a ``'tp'`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.3: TP "not present" — its
+``model_parallel/`` holds only MoE); first-class here, additive, because
+sharding the attention heads and FFN width over ICI is the natural TPU way
+to fit models past one chip's HBM.
+
+Layout (Shoeybi et al., arXiv 1909.08053, re-derived for shard_map):
+
+- column-parallel matmuls (q/k/v projections, FFN up/gate) shard the OUTPUT
+  feature dim: each shard holds heads/tp heads or d_ff/tp columns and
+  consumes the replicated activation;
+- row-parallel matmuls (attention output, FFN down) shard the INPUT dim and
+  their partial outputs are summed with one ``lax.psum`` per block;
+- the conjugate "g" function (:func:`tp_gather_grad`) is identity in
+  forward and ``psum`` in backward, inserted right before each
+  column-parallel matmul so that norm/embedding gradients — whose cotangent
+  arrives partially from every shard's branch — come out exact under
+  ``shard_map(check_vma=False)``, where no automatic replication bookkeeping
+  exists.
+
+Inside the jitted step each shard's parameters are its LOCAL slices
+(natural shapes, no stacking); the trainer shards the global arrays along
+the dimensions reported by the model's ``tp_param_dim``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_gather_grad(x, axis_name: str):
+    """Identity forward, ``psum`` over ``axis_name`` backward — Megatron's
+    "g" function.  Place immediately before a column-parallel matmul."""
+    return x
+
+
+def _ggrad_fwd(x, axis_name):
+    return x, None
+
+
+def _ggrad_bwd(axis_name, _, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+tp_gather_grad.defvjp(_ggrad_fwd, _ggrad_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_reduce(x, axis_name: str):
+    """``psum`` forward, identity backward — Megatron's "f" conjugate.
+    Closes each row-parallel matmul (attention output / FFN down).
+
+    A raw ``lax.psum`` would be wrong here: under ``shard_map``'s unchecked
+    mode the transpose of ``psum`` is ``psum`` again, so the (already
+    replicated) cotangent would be multiplied by the axis size at every
+    block and the error compounds multiplicatively through the network.
+    """
+    return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+tp_reduce.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# flax's truncated-normal initializers divide by the stddev of the
+# [-2, 2]-truncated unit normal so the DRAWN stddev equals the target
+_TRUNC_STD = 0.87962566103423978
+
+
+def globalize_tp_params(params, rng, tp_size: int,
+                        tp_param_dim: Callable[[str], Optional[int]],
+                        fan_in_dims: Optional[Callable] = None):
+    """Re-draw tensor-parallel leaves at GLOBAL shape.
+
+    ``model.init`` outside the mesh yields tp leaves of LOCAL shape (e.g.
+    ``[d, d_ff/tp]``) — identical on every shard, a bad symmetric init.
+    This expands each leaf's sharded dim by ``tp_size`` with a fresh
+    lecun-normal draw over the GLOBAL fan-in (``fan_in_dims(name)`` gives
+    the contracting dims of the global kernel; default: the transformer
+    family's table).  The returned tree is only valid through
+    ``BaguaTrainer(tp_axis=...)``.
+    """
+    from ..tensor import _name_of_path
+
+    if fan_in_dims is None:
+        from ..models.transformer import tp_param_fan_in_dims
+
+        fan_in_dims = tp_param_fan_in_dims
+
+    def fix(path, leaf):
+        name = _name_of_path(path)
+        dim = tp_param_dim(name)
+        if dim is None or tp_size == 1:
+            return leaf
+        nonlocal rng
+        rng, sub = jax.random.split(rng)
+        shape = list(leaf.shape)
+        shape[dim] = shape[dim] * tp_size
+        contracting = fan_in_dims(name) or tuple(range(len(shape) - 1))
+        fan_in = 1
+        for ax in contracting:
+            fan_in *= shape[ax]
+        std = (1.0 / max(fan_in, 1)) ** 0.5 / _TRUNC_STD
+        return std * jax.random.truncated_normal(
+            sub, -2.0, 2.0, tuple(shape), jnp.float32
+        ).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(fix, params)
