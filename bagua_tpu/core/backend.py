@@ -79,6 +79,8 @@ class BaguaTrainer:
         seq_axis: Optional[str] = None,
         tp_axis: Optional[str] = None,
         tp_param_dim=None,
+        pp_axis: Optional[str] = None,
+        pp_param_dim=None,
     ):
         """``expert_axis``: mesh axis carrying expert parallelism (MoE).
         Expert params are sharded over it and excluded from the data-parallel
@@ -105,7 +107,15 @@ class BaguaTrainer:
         the data-parallel bucket plan (each shard owns its slice; grads need
         averaging over dp only), while dense-leaf grads are exact and
         identical across tp thanks to the model's conjugate collectives —
-        so the bucket allreduce deliberately does NOT span tp."""
+        so the bucket allreduce deliberately does NOT span tp.
+
+        ``pp_axis``: mesh axis carrying pipeline parallelism (GPipe
+        microbatch schedule; see ``parallel/pipeline.py``).  Stage-stacked
+        leaves (``pp_param_dim(name) == 0``) are sharded and averaged over
+        data axes only, like tp slices.  Replicated leaves (embedding,
+        head) get PARTIAL grads — each stage contributes only its own use —
+        so they are scaled by pp_size and the bucket allreduce DOES span
+        pp, turning its average into the required sum."""
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.algorithm = algorithm
@@ -123,28 +133,39 @@ class BaguaTrainer:
         # fail fast on typo'd axis names: silently nulling them would include
         # expert params in the dense DP plan and corrupt MoE training
         for label, ax in (("expert_axis", expert_axis), ("seq_axis", seq_axis),
-                          ("tp_axis", tp_axis)):
+                          ("tp_axis", tp_axis), ("pp_axis", pp_axis)):
             if ax is not None and ax not in mesh.axis_names:
                 raise ValueError(
                     f"{label}={ax!r} is not a mesh axis "
                     f"(mesh axes: {mesh.axis_names})"
                 )
-        if tp_axis is not None:
+        if tp_axis is not None or pp_axis is not None:
+            label = "tp_axis" if tp_axis is not None else "pp_axis"
             if expert_axis is not None:
                 raise NotImplementedError(
-                    "combining tp_axis with expert_axis is not supported yet"
+                    f"combining {label} with expert_axis is not supported yet"
+                )
+            if tp_axis is not None and pp_axis is not None:
+                raise NotImplementedError(
+                    "combining tp_axis with pp_axis is not supported yet"
                 )
             if not algorithm.replicated_params:
                 raise NotImplementedError(
-                    "tensor parallelism requires a replicated-params "
-                    "algorithm (gossip state is per-rank)"
+                    f"{label} requires a replicated-params algorithm "
+                    "(gossip state is per-rank)"
                 )
         self.tp_axis = tp_axis
+        self.pp_axis = pp_axis
         if tp_param_dim is None and tp_axis is not None:
             from ..models.transformer import tp_param_dim as _default_tp_dim
 
             tp_param_dim = _default_tp_dim
+        if pp_param_dim is None and pp_axis is not None:
+            from ..parallel.pipeline import pp_param_dim as _default_pp_dim
+
+            pp_param_dim = _default_pp_dim
         self._tp_param_dim = tp_param_dim
+        self._pp_param_dim = pp_param_dim
         self.expert_axis = expert_axis
         self._expert_filter = self._make_expert_filter(expert_params, expert_keyword)
         self.seq_axis = seq_axis
@@ -152,13 +173,15 @@ class BaguaTrainer:
             dp_axes = tuple(
                 a for a in mesh.axis_names
                 if a in ("dp", "inter", "intra")
-                and a not in (self.expert_axis, self.seq_axis, self.tp_axis)
+                and a not in (self.expert_axis, self.seq_axis, self.tp_axis,
+                              self.pp_axis)
             )
             if (
                 not dp_axes
                 and self.expert_axis is None
                 and self.seq_axis is None
                 and self.tp_axis is None
+                and self.pp_axis is None
             ):
                 dp_axes = (mesh.axis_names[0],)
         self.dp_axes = tuple(dp_axes)
@@ -171,9 +194,12 @@ class BaguaTrainer:
             )
         # the batch is sharded over dp AND ep, so dense-grad comm spans both;
         # expert grads are only averaged over dp (experts differ across ep);
-        # sp shards contribute partial grads, so comm spans sp too
+        # sp shards contribute partial grads, so comm spans sp too; pp-dense
+        # grads are partial per stage, so comm spans pp (after a pp_size
+        # prescale that turns the average into the required sum)
         self.comm_axes = self.dp_axes + tuple(
-            a for a in (self.expert_axis, self.seq_axis) if a is not None
+            a for a in (self.expert_axis, self.seq_axis, self.pp_axis)
+            if a is not None
         )
         self.world_size = mesh_axis_size(mesh, self.comm_axes)
         self.bucket_bytes = bucket_bytes or env.get_default_bucket_size()
@@ -242,15 +268,24 @@ class BaguaTrainer:
     def _is_expert_name(self, name: str) -> bool:
         return self.expert_axis is not None and self._expert_filter(name)
 
-    def _tp_dim(self, name: str) -> Optional[int]:
-        if self.tp_axis is None or self._tp_param_dim is None:
-            return None
-        return self._tp_param_dim(name)
+    @property
+    def _shard_axis(self) -> Optional[str]:
+        """The model-parallel axis whose param slices bypass the bucket
+        plan (tp or pp — mutually exclusive)."""
+        return self.tp_axis if self.tp_axis is not None else self.pp_axis
+
+    def _shard_dim(self, name: str) -> Optional[int]:
+        if self.tp_axis is not None and self._tp_param_dim is not None:
+            return self._tp_param_dim(name)
+        if self.pp_axis is not None and self._pp_param_dim is not None:
+            return self._pp_param_dim(name)
+        return None
 
     def _build_plan(self, params) -> BucketPlan:
         candidates = [
             p for p in build_params(params)
-            if not self._is_expert_name(p.name) and self._tp_dim(p.name) is None
+            if not self._is_expert_name(p.name)
+            and self._shard_dim(p.name) is None
         ]
         named = self.algorithm.init_tensors(candidates)
         self._named_params = named
@@ -259,13 +294,13 @@ class BaguaTrainer:
         return self.algorithm.tensors_to_buckets(decl_buckets, named, self.world_size)
 
     def _tp_param_spec_tree(self, params):
-        """Per-leaf PartitionSpecs: tp leaves sharded along their reported
-        dim, everything else replicated."""
+        """Per-leaf PartitionSpecs: tp/pp leaves sharded along their
+        reported dim, everything else replicated."""
         def leaf_spec(path, leaf):
-            dim = self._tp_dim(_name_of_path(path))
+            dim = self._shard_dim(_name_of_path(path))
             if dim is None:
                 return P()
-            return P(*([None] * dim + [self.tp_axis]))
+            return P(*([None] * dim + [self._shard_axis]))
 
         return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
@@ -344,11 +379,11 @@ class BaguaTrainer:
                 shard_map(init_fn, mesh=mesh, in_specs=(P(),), out_specs=P(),
                           check_vma=False)
             )(params)
-            if self.tp_axis is not None:
+            if self._shard_axis is not None:
                 if algo_state is not None:
                     raise NotImplementedError(
-                        "tensor parallelism with stateful algorithms "
-                        "(QAdam-style) is not supported yet"
+                        "tensor/pipeline parallelism with stateful "
+                        "algorithms (QAdam-style) is not supported yet"
                     )
                 self._param_specs = self._tp_param_spec_tree(params)
                 sharded = {}
@@ -404,6 +439,18 @@ class BaguaTrainer:
             step = state.step
 
             loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+            if self.pp_axis is not None and mesh.shape[self.pp_axis] > 1:
+                # replicated-leaf grads are PARTIAL per pipeline stage: the
+                # bucket allreduce spans pp, so prescaling by pp_size turns
+                # its average into the required cross-stage sum
+                pp_size = mesh.shape[self.pp_axis]
+
+                def pp_dense_grad(path, g):
+                    if self._shard_dim(_name_of_path(path)) is not None:
+                        return g
+                    return g * pp_size
+
+                grads = jax.tree_util.tree_map_with_path(pp_dense_grad, grads)
             grads, algo_state = algo.process_grads(ctx, grads, params, algo_state, step)
             if expert is not None:
                 # Expert grads bypass the bucket plan.  The all_to_all
@@ -425,14 +472,17 @@ class BaguaTrainer:
                     ),
                     grads,
                 )
-            if self.tp_axis is not None:
-                # tp-slice grads bypass the bucket plan: each shard owns its
-                # slice (complete gradient, thanks to the model's conjugate
-                # collectives) — average over the data axes only, no rescale
+            if self._shard_axis is not None:
+                # tp/pp-slice grads bypass the bucket plan: each shard owns
+                # its slice (complete gradient) — average over the data axes
+                # only, no rescale
                 tp_dp = expert_dp
 
                 def tp_grad(path, g):
-                    if self._tp_dim(_name_of_path(path)) is None or not tp_dp:
+                    if (
+                        self._shard_dim(_name_of_path(path)) is None
+                        or not tp_dp
+                    ):
                         return g
                     return jax.lax.pmean(g, tp_dp)
 
@@ -459,7 +509,7 @@ class BaguaTrainer:
             pspec = P((expert,))
             state_specs = TrainState(step=P(), params=pspec, opt_state=pspec,
                                      algo_state=pspec)
-        elif self.tp_axis is not None:
+        elif self._shard_axis is not None:
             state_specs = TrainState(
                 step=P(), params=self._param_specs,
                 opt_state=self._opt_specs, algo_state=P(),

@@ -1,0 +1,87 @@
+"""Pipeline parallelism: GPipe schedule vs sequential golden (golden-model
+pattern, SURVEY.md §4).  PP is additive — the reference has none (SURVEY.md
+§2.3) — so the golden is the same model run sequentially (pp=1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.core.backend import BaguaTrainer
+from bagua_tpu.models.transformer import TransformerConfig
+from bagua_tpu.parallel.mesh import build_mesh
+from bagua_tpu.parallel.pipeline import (
+    PipelinedTransformerLM,
+    globalize_pp_params,
+    pp_lm_loss_fn,
+)
+
+PP = 4
+
+
+def _cfg():
+    return TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                             n_layers=4, d_ff=64, max_seq_len=8,
+                             dtype=jnp.float32)
+
+
+def _global_params(cfg, key=0):
+    local = PipelinedTransformerLM(cfg, pp_size=PP).init(
+        jax.random.PRNGKey(key), jnp.zeros((2, cfg.max_seq_len + 1), jnp.int32)
+    )["params"]
+    return globalize_pp_params(local, jax.random.PRNGKey(key + 1), PP)
+
+
+def test_pp_one_step_matches_sequential():
+    """One SGD step with dp=1 x pp=4 (2 microbatches) must equal the
+    sequential pp=1 run on the full batch — validates the tick schedule,
+    the ppermute handoff grads, the stage-leaf sharding, and the pp_size
+    prescale of the partial embedding/head grads."""
+    cfg = _cfg()
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 9), 0, 64)
+    params = _global_params(cfg)
+
+    seq_model = PipelinedTransformerLM(cfg, pp_size=1)
+    t1 = BaguaTrainer(
+        pp_lm_loss_fn(seq_model), optax.sgd(0.1), GradientAllReduceAlgorithm(),
+        mesh=build_mesh({"dp": 1}, jax.devices()[:1]), autotune=False,
+    )
+    s1 = t1.init(params)
+    s1, loss1 = t1.train_step(s1, t1.shard_batch({"tokens": tokens}))
+
+    pp_model = PipelinedTransformerLM(cfg, pp_size=PP, n_microbatches=2)
+    tpp = BaguaTrainer(
+        pp_lm_loss_fn(pp_model), optax.sgd(0.1), GradientAllReduceAlgorithm(),
+        mesh=build_mesh({"dp": 1, "pp": PP}, jax.devices()[:PP]),
+        pp_axis="pp", autotune=False,
+    )
+    spp = tpp.init(params)
+    spp, losspp = tpp.train_step(spp, tpp.shard_batch({"tokens": tokens}))
+
+    np.testing.assert_allclose(float(loss1), float(losspp), atol=1e-5)
+    flat1 = jax.tree_util.tree_leaves_with_path(t1.unstack_params(s1))
+    flatpp = dict(jax.tree_util.tree_leaves_with_path(tpp.unstack_params(spp)))
+    for path, leaf in flat1:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flatpp[path]), atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_pp_dp_trains():
+    cfg = _cfg()
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (8, 9), 0, 64)
+    params = _global_params(cfg, key=5)
+    model = PipelinedTransformerLM(cfg, pp_size=PP, n_microbatches=2)
+    trainer = BaguaTrainer(
+        pp_lm_loss_fn(model), optax.adam(1e-2), GradientAllReduceAlgorithm(),
+        mesh=build_mesh({"dp": 2, "pp": PP}), pp_axis="pp", autotune=False,
+    )
+    state = trainer.init(params)
+    batch = trainer.shard_batch({"tokens": tokens})
+    losses = []
+    for _ in range(15):
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
