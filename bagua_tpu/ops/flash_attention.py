@@ -248,14 +248,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
-    """``lse``: [bh, 1, s] f32 (one sublane of the forward's stripe)."""
+def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+         dlse=None):
+    """``lse``: [bh, 1, s] f32 (one sublane of the forward's stripe).
+
+    ``dlse`` [bh, s]: cotangent of the logsumexp output (only when the
+    caller consumed lse, e.g. ring-attention merging).  It enters the
+    standard backward as ``ds_ij += p_ij * dlse_i``, i.e. an effective
+    ``delta_i - dlse_i`` — no kernel change needed.
+    """
     bh, s, d = q.shape
     delta = (
         (do.astype(jnp.float32) * o.astype(jnp.float32))
         .sum(axis=-1)
         .reshape(bh, 1, s)
     )
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32).reshape(bh, 1, s)
 
     seq_spec = pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0),
                             memory_space=pltpu.VMEM)
@@ -300,22 +309,56 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return o
-
-
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
     o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse[:, :1, :])
+    return o, lse[:, 0, :]
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return (o, lse[:, 0, :]), (q, k, v, o, lse[:, :1, :])
+
+
+def _flash_lse_bwd(causal, block_q, block_k, interpret, res, cts):
     q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret)
+    do, dlse = cts
+    return _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+                dlse=dlse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool, block_q: int = 0,
+                             block_k: int = 0, interpret: bool = False):
+    """Like :func:`flash_attention` but also returns the per-row logsumexp
+    ([batch, heads, seq] f32) — the merge statistic for combining partial
+    attentions over K/V blocks (ring attention).  No fallback: the caller
+    gates on :func:`flash_supported`.  Output ``o`` is f32 (merging
+    precision)."""
+    from .tiles import pick_block
+
+    b, s, h, d = q.shape
+    # the kernels size K/V buffers from q's length — equal chunks only
+    assert k.shape[1] == s and v.shape[1] == s, (q.shape, k.shape, v.shape)
+    block_q = block_q or pick_block(s)
+    block_k = block_k or pick_block(s)
+    if s % block_q or s % block_k:
+        # no silent fallback here (the caller gates on flash_supported):
+        # a non-divisible grid would TRUNCATE the sequence
+        raise ValueError(
+            f"seq {s} is not a multiple of block sizes "
+            f"({block_q}, {block_k}); flash_attention_with_lse has no "
+            "reference fallback"
+        )
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    o, lse = _flash_lse(fold(q), fold(k), fold(v), causal, block_q, block_k,
+                        interpret)
+    o = o.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(jnp.float32)
+    return o, lse.reshape(b, h, s)
 
 
 def _enabled() -> bool:
@@ -365,8 +408,9 @@ def flash_attention(q, k, v, dtype=None, *, causal: bool = True,
     def fold(x):  # [b, s, h, d] -> [b*h, s, d]
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    o = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k,
-               interpret)
+    # lse is discarded; its zero cotangent enters the backward as a no-op
+    o, _ = _flash_lse(fold(q), fold(k), fold(v), causal, block_q, block_k,
+                      interpret)
     return (
         o.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(dtype)
     )

@@ -71,8 +71,9 @@ class PipelinedTransformerLM(nn.Module):
                          dtype=cfg.dtype, param_dtype=cfg.param_dtype)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+        block_cls = nn.remat(_ScanBlock) if cfg.remat else _ScanBlock
         blocks = nn.scan(
-            _ScanBlock,
+            block_cls,
             variable_axes={"params": 0},
             split_rngs={"params": True},
             length=n_local,
@@ -161,7 +162,7 @@ def globalize_pp_params(params, rng, pp_size: int):
     """
     from ..models.transformer import tp_param_fan_in_dims
     from ..tensor import _name_of_path
-    from .tensor_parallel import _TRUNC_STD
+    from .tensor_parallel import redraw_lecun
 
     def fix(path, leaf):
         name = _name_of_path(path)
@@ -179,12 +180,6 @@ def globalize_pp_params(params, rng, pp_size: int):
             tuple(ax + 1 for ax in inner) if inner is not None
             else tuple(range(1, len(shape) - 1))
         )
-        fan_in = 1
-        for ax in contracting:
-            fan_in *= shape[ax]
-        std = (1.0 / max(fan_in, 1)) ** 0.5 / _TRUNC_STD
-        return std * jax.random.truncated_normal(
-            sub, -2.0, 2.0, shape, jnp.float32
-        ).astype(leaf.dtype)
+        return redraw_lecun(sub, shape, contracting, leaf.dtype)
 
     return jax.tree_util.tree_map_with_path(fix, params)

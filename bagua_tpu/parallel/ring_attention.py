@@ -22,14 +22,28 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def make_ring_attention(sp_size: int, axis_name: str = "sp"):
+def make_ring_attention(sp_size: int, axis_name: str = "sp",
+                        use_flash: str = "auto", interpret: bool = False):
     """Build an ``attn_fn(q, k, v, dtype)`` for ``TransformerLM`` that runs
     causal attention over a sequence sharded on ``axis_name``.
 
     Inputs per shard: [batch, seq_local, heads, head_dim] where shard i holds
     global positions [i*seq_local, (i+1)*seq_local).  Must run inside
     shard_map over a mesh containing ``axis_name`` (of size ``sp_size``).
+
+    ``use_flash``: ``"auto"`` (Pallas flash kernel per ring step when
+    :func:`bagua_tpu.ops.flash_attention.flash_supported` says it pays),
+    ``"always"`` (force the kernel path), or ``"never"``.  ``interpret``
+    runs the kernels in the Pallas interpreter (CPU tests).  The flash form
+    computes each resident K/V block with the fused kernel and combines
+    blocks with the standard (o, logsumexp) merge — identical math to the
+    inline online-softmax loop, but the [s_local, s_local] scores never
+    touch HBM.
     """
+    if use_flash not in ("auto", "always", "never"):
+        raise ValueError(
+            f"use_flash={use_flash!r}: expected 'auto', 'always', or 'never'"
+        )
 
     def attn_fn(q, k, v, dtype):
         b, s, h, d = q.shape
@@ -42,6 +56,14 @@ def make_ring_attention(sp_size: int, axis_name: str = "sp"):
             from ..models.transformer import causal_attention
 
             return causal_attention(q, k, v, dtype)
+
+        from ..ops.flash_attention import flash_supported
+
+        if use_flash == "always" or (
+            use_flash == "auto" and flash_supported(s, d)
+        ):
+            return _ring_flash(q, k, v, dtype, sp_size, axis_name,
+                               interpret=interpret)
         my = lax.axis_index(axis_name)
         q32 = q.astype(jnp.float32)
         q_pos = my * s + jnp.arange(s)
@@ -80,3 +102,43 @@ def make_ring_attention(sp_size: int, axis_name: str = "sp"):
         return out.transpose(0, 2, 1, 3).astype(dtype)  # [b, s, h, d]
 
     return attn_fn
+
+
+def _merge_partials(o1, lse1, o2, lse2):
+    """Combine two normalized partial attentions over disjoint K/V sets.
+    ``o``: [b, s, h, d] f32, ``lse``: [b, h, s] f32."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    wsum = w1 + w2
+    wt = lambda w: (w / wsum).transpose(0, 2, 1)[..., None]  # [b, s, h, 1]
+    return wt(w1) * o1 + wt(w2) * o2, m + jnp.log(wsum)
+
+
+def _ring_flash(q, k, v, dtype, sp_size, axis_name, interpret=False):
+    """Ring attention with the fused flash kernel per resident block.
+
+    Step 0 is the causal diagonal block; later steps are full
+    (non-causal) cross-attention against earlier shards' K/V, merged with
+    the (o, lse) statistics.  Blocks originating AFTER this shard are
+    masked out by forcing their lse to -inf (zero merge weight, zero
+    gradient) — same wasted bubble compute as the inline loop, but every
+    matmul runs in the MXU-blocked kernel.
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % sp_size) for i in range(sp_size)]
+
+    o, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                      interpret=interpret)
+    k_blk, v_blk = k, v
+    for t in range(1, sp_size):
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = (my - t) % sp_size
+        o_t, lse_t = flash_attention_with_lse(q, k_blk, v_blk, causal=False,
+                                              interpret=interpret)
+        lse_t = jnp.where(src < my, lse_t, NEG_INF)
+        o, lse = _merge_partials(o, lse, o_t, lse_t)
+    return o.astype(dtype)

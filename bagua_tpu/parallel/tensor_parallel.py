@@ -81,6 +81,19 @@ tp_reduce.defvjp(_reduce_fwd, _reduce_bwd)
 _TRUNC_STD = 0.87962566103423978
 
 
+def redraw_lecun(rng, shape, contracting, dtype):
+    """One lecun-normal draw at ``shape`` with variance ``1/fan_in`` over
+    the given contracting dims (flax-matching truncated normal).  Shared by
+    the tp and pp global-init redraws."""
+    fan_in = 1
+    for ax in contracting:
+        fan_in *= shape[ax]
+    std = (1.0 / max(fan_in, 1)) ** 0.5 / _TRUNC_STD
+    return std * jax.random.truncated_normal(
+        rng, -2.0, 2.0, tuple(shape), jnp.float32
+    ).astype(dtype)
+
+
 def globalize_tp_params(params, rng, tp_size: int,
                         tp_param_dim: Callable[[str], Optional[int]],
                         fan_in_dims: Optional[Callable] = None):
@@ -111,12 +124,6 @@ def globalize_tp_params(params, rng, tp_size: int,
         shape = list(leaf.shape)
         shape[dim] = shape[dim] * tp_size
         contracting = fan_in_dims(name) or tuple(range(len(shape) - 1))
-        fan_in = 1
-        for ax in contracting:
-            fan_in *= shape[ax]
-        std = (1.0 / max(fan_in, 1)) ** 0.5 / _TRUNC_STD
-        return std * jax.random.truncated_normal(
-            sub, -2.0, 2.0, tuple(shape), jnp.float32
-        ).astype(leaf.dtype)
+        return redraw_lecun(sub, shape, contracting, leaf.dtype)
 
     return jax.tree_util.tree_map_with_path(fix, params)

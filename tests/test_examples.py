@@ -63,3 +63,9 @@ def test_imagenet_resnet_example_tiny():
         "--batch-per-device", "1",
     )
     assert "final_loss" in out and "cache_entries" in out
+
+
+@pytest.mark.slow
+def test_parallelism_zoo_example():
+    out = _run_example("parallelism_zoo.py", timeout=900)
+    assert "all parallelism axes ran" in out
