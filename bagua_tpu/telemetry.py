@@ -45,12 +45,16 @@ def _leaf_cost_flops(fn: Callable, leaf) -> Optional[float]:
 
 
 def _leaf_cost_walltime(fn: Callable, leaf, repeats: int = 3) -> float:
+    from .utils import device_fence
+
     compiled = jax.jit(fn)
-    jax.block_until_ready(compiled(leaf))  # compile + warm
+    device_fence(compiled(leaf))  # compile + warm
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        jax.block_until_ready(compiled(leaf))
+        # readback fence: block_until_ready is not reliable on tunneled
+        # transports and would time only the dispatch
+        device_fence(compiled(leaf))
         best = min(best, time.perf_counter() - t0)
     return best
 

@@ -67,14 +67,19 @@ def _emit(record: dict) -> dict:
 
 
 def _time_steps(trainer, state, data, timed=TIMED_STEPS, warmup=WARMUP_STEPS):
+    """Steps are state-chained, so a HOST READBACK of the final loss forces
+    every preceding step to completion.  ``jax.block_until_ready`` is not a
+    reliable fence on tunneled/remote devices (it can return while work is
+    still queued), so the timer brackets an explicit readback."""
     for _ in range(warmup):
         state, loss = trainer.train_step(state, data)
-    jax.block_until_ready(loss)
+    float(loss)  # drain the queue before the timer starts
     t0 = time.perf_counter()
     for _ in range(timed):
         state, loss = trainer.train_step(state, data)
-    jax.block_until_ready(loss)
-    return time.perf_counter() - t0, state, float(loss)
+    lossf = float(loss)  # forces the chained steps to completion
+    dt = time.perf_counter() - t0
+    return dt, state, lossf
 
 
 def bench_family(family: str, algo_factory, mesh, n_dev: int) -> dict:
@@ -111,9 +116,7 @@ def bench_family(family: str, algo_factory, mesh, n_dev: int) -> dict:
     }
 
 
-def bench_moe(mesh, n_dev: int) -> dict:
-    """Expert-parallel MoE throughput (reference MoE CI run,
-    benchmark_master.sh:126-153; here tokens/s on the transformer MoE)."""
+def _bench_moe_impl(mesh, n_dev: int, dropless: bool) -> float:
     from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
     from bagua_tpu.core.backend import BaguaTrainer
     from bagua_tpu.model_parallel.moe import MoEMLP, moe_lm_loss_fn
@@ -129,7 +132,8 @@ def bench_moe(mesh, n_dev: int) -> dict:
     model = TransformerLM(
         cfg,
         mlp_factory=lambda i: (
-            lambda: MoEMLP(n_experts=max(2, 2 * ep), d_ff=cfg.d_ff, ep_size=ep)
+            lambda: MoEMLP(n_experts=max(8, 2 * ep), d_ff=cfg.d_ff,
+                           ep_size=ep, dropless=dropless)
         ) if i % 2 == 1 else None,
     )
     batch = 8 * n_dev
@@ -148,12 +152,40 @@ def bench_moe(mesh, n_dev: int) -> dict:
     )
     data = trainer.shard_batch({"tokens": tokens})
     dt, _, _ = _time_steps(trainer, state, data, timed=10)
-    tokens_per_sec = 10 * batch * cfg.max_seq_len / dt
+    return 10 * batch * cfg.max_seq_len / dt
+
+
+def bench_moe(mesh, n_dev: int) -> dict:
+    """Expert-parallel MoE throughput (reference MoE CI run,
+    benchmark_master.sh:126-153; here tokens/s on the transformer MoE)."""
+    tokens_per_sec = _bench_moe_impl(mesh, n_dev, dropless=False)
+    # metric renamed when the model grew from 2 to 8 experts — the old
+    # moe_transformer_tokens_per_sec numbers are not comparable
     return {
-        "metric": "moe_transformer_tokens_per_sec",
+        "metric": "moe_transformer_e8_tokens_per_sec",
         "value": round(tokens_per_sec, 0),
         "unit": "tok/s",
         "vs_baseline": None,
+    }
+
+
+def bench_moe_dropless(mesh, n_dev: int, capacity_tps=None) -> dict:
+    """Dropless (sort + grouped-matmul) MoE vs the GShard capacity path on
+    the identical model/config (``vs_baseline`` = dropless/capacity).
+
+    At this T the dense dispatch einsum is still MXU-friendly, so capacity
+    is typically somewhat faster — dropless buys exact routing (no token
+    ever dropped) and O(T*k) memory where the capacity dispatch tensor is
+    O(T^2): at ~32K tokens/layer the capacity path OOMs a v5p chip while
+    dropless keeps running."""
+    if capacity_tps is None:
+        capacity_tps = _bench_moe_impl(mesh, n_dev, dropless=False)
+    dropless_tps = _bench_moe_impl(mesh, n_dev, dropless=True)
+    return {
+        "metric": "moe_dropless_e8_tokens_per_sec",
+        "value": round(dropless_tps, 0),
+        "unit": "tok/s",
+        "vs_baseline": round(dropless_tps / capacity_tps, 3),
     }
 
 
@@ -327,7 +359,11 @@ def main():
         for family, factory in _algorithms().items():
             records.append(_emit(bench_family(family, factory, mesh, n_dev)))
         records.append(_emit(bench_vgg16(mesh, n_dev)))
-        records.append(_emit(bench_moe(mesh, n_dev)))
+        moe_rec = _emit(bench_moe(mesh, n_dev))
+        records.append(moe_rec)
+        records.append(_emit(
+            bench_moe_dropless(mesh, n_dev, capacity_tps=moe_rec["value"])
+        ))
         records.append(_emit(bench_bert(mesh, n_dev)))
         records.append(_emit(bench_longctx(mesh, n_dev)))
         with open("BENCH_SUITE.json", "w") as f:

@@ -29,15 +29,16 @@ from jax import shard_map
 
 
 def _bench(fn, x, iters=10, warmup=3):
+    from bagua_tpu.utils import device_fence
+
     compiled = jax.jit(fn)
-    jax.block_until_ready(compiled(x))
-    for _ in range(warmup - 1):
-        compiled(x)
-    jax.block_until_ready(compiled(x))
+    for _ in range(warmup):
+        out = compiled(x)
+    device_fence(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = compiled(x)
-    jax.block_until_ready(out)
+    device_fence(out)  # readback: block_until_ready is not a real fence
     return (time.perf_counter() - t0) / iters
 
 

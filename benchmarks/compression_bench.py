@@ -31,12 +31,14 @@ import numpy as np
 
 
 def _time(fn, *args, iters=10):
+    from bagua_tpu.utils import device_fence
+
     out = fn(*args)
-    jax.block_until_ready(out)
+    device_fence(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    device_fence(out)  # readback: block_until_ready is not a real fence
     return (time.perf_counter() - t0) / iters
 
 
@@ -132,11 +134,11 @@ def bench_e2e(steps=10):
         st = tr.init(params)
         data = tr.shard_batch({"x": x, "y": y})
         st, loss = tr.train_step(st, data)
-        jax.block_until_ready(loss)
+        float(loss)
         t0 = time.perf_counter()
         for _ in range(steps):
             st, loss = tr.train_step(st, data)
-        jax.block_until_ready(loss)
+        float(loss)  # readback fence (steps are state-chained)
         results[name] = (time.perf_counter() - t0) / steps
     print(json.dumps({
         "bench": "e2e_fat_mlp",

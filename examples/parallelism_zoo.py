@@ -11,6 +11,10 @@ On a real TPU pod slice, drop the env overrides and size the meshes to
 """
 
 import os
+import sys
+
+# runnable from a plain checkout: `python examples/parallelism_zoo.py`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 if os.environ.get("BAGUA_ZOO_REAL_DEVICES", "0") != "1":
     # demo default: a virtual 8-device CPU mesh (works everywhere); set

@@ -90,3 +90,60 @@ def test_try_restore_empty_dir(tmp_path):
     step, s2 = mgr.try_restore(s)
     assert step is None and s2 is s
     mgr.close()
+
+
+def test_save_restore_tp_sharded_state(tmp_path):
+    """Checkpoint round-trip with tensor-parallel (per-dim sharded) state:
+    restore must land tp leaves back on their NamedShardings so the jitted
+    step accepts them (SURVEY.md §5.4 + the tp axis added this round)."""
+    from bagua_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss_fn, tp_param_dim,
+    )
+    from bagua_tpu.parallel.tensor_parallel import globalize_tp_params
+
+    TP = 4
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_seq_len=8, dtype=jnp.float32,
+                            tp_axis="tp", tp_size=TP)
+    model = TransformerLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 9), 0, 64)
+
+    def new_trainer():
+        return BaguaTrainer(
+            lm_loss_fn(model), optax.adam(1e-2), GradientAllReduceAlgorithm(),
+            mesh=build_mesh({"dp": 2, "tp": TP}), tp_axis="tp",
+            autotune=False,
+        )
+
+    params = globalize_tp_params(
+        model.init(jax.random.PRNGKey(1), tokens[:2, :-1])["params"],
+        jax.random.PRNGKey(2), TP, tp_param_dim,
+    )
+    batch_maker = new_trainer()
+    batch = batch_maker.shard_batch({"tokens": tokens})
+
+    t0 = new_trainer()
+    s = t0.init(params)
+    ref = []
+    for _ in range(4):
+        s, loss = t0.train_step(s, batch)
+        ref.append(float(loss))
+
+    t1 = new_trainer()
+    s1 = t1.init(params)
+    for _ in range(2):
+        s1, _ = t1.train_step(s1, batch)
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    assert mgr.save(2, s1)
+    mgr.wait()
+
+    t2 = new_trainer()
+    s2 = t2.init(params)
+    step, s2 = mgr.restore(s2)
+    assert step == 2
+    resumed = []
+    for _ in range(2):
+        s2, loss = t2.train_step(s2, batch)
+        resumed.append(float(loss))
+    np.testing.assert_allclose(resumed, ref[2:], rtol=1e-6)
+    mgr.close()
