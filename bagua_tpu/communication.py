@@ -133,15 +133,15 @@ class BaguaCommunicator:
         raise ValueError(f"reduce_scatter supports SUM/AVG, got {op}")
 
     def alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
-        if len(self.axes) != 1:
-            raise ValueError("alltoall needs a single mesh axis")
-        return lax.all_to_all(x, self.axes[0], split_axis=split_axis,
+        # multiple axes are treated as one flattened axis (XLA supports
+        # axis-name sequences), e.g. the ('dp','pp') bucket communicator
+        ax = self.axes[0] if len(self.axes) == 1 else tuple(self.axes)
+        return lax.all_to_all(x, ax, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=False)
 
     def alltoall_tiled(self, x, split_axis: int = 0, concat_axis: int = 0):
-        if len(self.axes) != 1:
-            raise ValueError("alltoall needs a single mesh axis")
-        return lax.all_to_all(x, self.axes[0], split_axis=split_axis,
+        ax = self.axes[0] if len(self.axes) == 1 else tuple(self.axes)
+        return lax.all_to_all(x, ax, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
 
     def alltoall_v(

@@ -381,9 +381,12 @@ class BaguaTrainer:
             )(params)
             if self._shard_axis is not None:
                 if algo_state is not None:
+                    # optimizer-owned state (QAdam momenta) IS supported —
+                    # it rides the suffix-matched opt_state specs; only
+                    # algorithm-side state trees have no spec mapping yet
                     raise NotImplementedError(
-                        "tensor/pipeline parallelism with stateful "
-                        "algorithms (QAdam-style) is not supported yet"
+                        "tensor/pipeline parallelism with algorithms that "
+                        "carry init_state trees is not supported yet"
                     )
                 self._param_specs = self._tp_param_spec_tree(params)
                 sharded = {}
@@ -566,10 +569,15 @@ class BaguaTrainer:
         fn = self._get_step_fn()
         if self._watchdog is not None:
             # synchronous under the watchdog: a cross-rank deadlock must
-            # surface as a stuck watched section, not an async no-op
+            # surface as a stuck watched section, not an async no-op.  The
+            # fence is a host readback — block_until_ready can return while
+            # work is still queued on tunneled transports, which would blind
+            # the watchdog to real hangs
+            from ..utils import device_fence
+
             with self._watchdog.watch(f"train_step[{self._step_counter}]"):
                 out = fn(state, batch)
-                jax.block_until_ready(out[1])
+                device_fence(out[1])
             return out
         return fn(state, batch)
 

@@ -133,6 +133,9 @@ def build_env(args, local_rank: int) -> dict:
             env.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={args.simulate_cpu_devices}"
         )
+        from ..env import sanitize_cpu_sim_env
+
+        sanitize_cpu_sim_env(env)
     return env
 
 

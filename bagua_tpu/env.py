@@ -102,3 +102,17 @@ def is_output_autotune_log() -> bool:
 
 def get_autotune_server_addr() -> str | None:
     return os.environ.get("AUTO_TUNE_SERVER_ADDR") or None
+
+
+#: env vars that register remote-accelerator PJRT plugins via sitecustomize;
+#: a registered plugin initializes on ``jax.devices()`` regardless of
+#: JAX_PLATFORMS and hangs every process when its transport is wedged
+ACCELERATOR_PLUGIN_ENV_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+
+def sanitize_cpu_sim_env(env: dict) -> dict:
+    """Strip accelerator-plugin triggers from a CPU-simulation child's env
+    (launcher ``--simulate_cpu_devices``, test harnesses, dryruns)."""
+    for var in ACCELERATOR_PLUGIN_ENV_VARS:
+        env.pop(var, None)
+    return env

@@ -16,6 +16,9 @@ def _run_example(script, *args, timeout=420):
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     )
+    from bagua_tpu.env import sanitize_cpu_sim_env
+
+    sanitize_cpu_sim_env(env)
     env.pop("BAGUA_SERVICE_PORT", None)
     env["BAGUA_SERVICE_PORT"] = "-1"
     # bootstrap via -c: an accelerator-plugin sitecustomize can pre-empt the

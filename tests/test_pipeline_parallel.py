@@ -85,3 +85,26 @@ def test_pp_dp_trains():
         state, loss = trainer.train_step(state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_pp_qadam_trains_through_phase_switch():
+    """QAdam under pp: the trainer's pp_size prescale makes the warmup
+    allreduce AND the compressed momentum average (both spanning pp) sum
+    the per-stage partial dense grads correctly."""
+    from bagua_tpu.algorithms.q_adam import QAdamAlgorithm
+
+    cfg = _cfg()
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (8, 9), 0, 64)
+    params = _global_params(cfg, key=7)
+    model = PipelinedTransformerLM(cfg, pp_size=PP, n_microbatches=2)
+    trainer = BaguaTrainer(
+        pp_lm_loss_fn(model), None, QAdamAlgorithm(warmup_steps=3, lr=3e-3),
+        mesh=build_mesh({"dp": 2, "pp": PP}), pp_axis="pp", autotune=False,
+    )
+    state = trainer.init(params)
+    batch = trainer.shard_batch({"tokens": tokens})
+    losses = []
+    for _ in range(8):  # crosses warmup->compressed at 3
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
