@@ -34,7 +34,7 @@ from ..bucket import BucketPlan, split_bucket_by_bucket_size
 from ..communication import BaguaCommunicator, ReduceOp, collapse_trivial_axes
 from ..parallel.mesh import build_mesh, hierarchical_mesh, mesh_axis_size
 from ..tensor import build_params, _name_of_path
-from ..utils import StatisticalAverage
+from ..utils import StatisticalAverage, device_fence
 
 logger = logging.getLogger(__name__)
 
@@ -227,6 +227,9 @@ class BaguaTrainer:
 
         timeout = get_comm_timeout_s()
         self._watchdog = get_global_watchdog(timeout) if timeout else None
+        from ..profiling import StepProfiler
+
+        self._profiler = StepProfiler.from_env()
         self._speed_tracker = StatisticalAverage()
         self._last_report_time = time.time()
         self._last_speed_time = time.time()
@@ -547,6 +550,8 @@ class BaguaTrainer:
 
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         self._step_counter += 1
+        if self._profiler is not None:
+            self._profiler.on_step(self._step_counter - 1)
         state = self.algorithm.host_pre_step(self, state)
         if self.algorithm.need_reset(self._step_counter - 1):
             self._phase += 1
@@ -573,8 +578,6 @@ class BaguaTrainer:
             # fence is a host readback — block_until_ready can return while
             # work is still queued on tunneled transports, which would blind
             # the watchdog to real hangs
-            from ..utils import device_fence
-
             with self._watchdog.watch(f"train_step[{self._step_counter}]"):
                 out = fn(state, batch)
                 device_fence(out[1])

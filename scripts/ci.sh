@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# CI gate — the analog of the reference's .buildkite/pipeline.yml
+# (pytest job + benchmark gates + lint workflows).  Runs entirely on the
+# virtual CPU mesh unless RUN_TPU_BENCH=1.
+#
+# Usage:  bash scripts/ci.sh            # lint + compile + tests + goldens
+#         RUN_TPU_BENCH=1 bash scripts/ci.sh   # + the TPU headline bench
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== lint (syntax + import graph) ==="
+python -m compileall -q bagua_tpu tests examples bench.py __graft_entry__.py
+python - <<'PY'
+import pathlib, ast, sys
+bad = []
+for p in pathlib.Path("bagua_tpu").rglob("*.py"):
+    tree = ast.parse(p.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "torch":
+            bad.append(str(p))
+if bad:
+    sys.exit(f"torch imports in the TPU package: {bad}")
+print("import graph clean")
+PY
+
+echo "=== unit + integration tests (8-device CPU mesh) ==="
+python -m pytest tests/ -q
+
+echo "=== multichip dryrun (virtual CPU mesh) ==="
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+echo "=== deterministic loss goldens (CPU) ==="
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+import runpy, sys; sys.argv=['bench.py','--goldens']; \
+runpy.run_path('bench.py', run_name='__main__')"
+
+if [[ "${RUN_TPU_BENCH:-0}" == "1" ]]; then
+  echo "=== TPU headline bench ==="
+  python bench.py
+fi
+
+echo "CI green"
