@@ -8,6 +8,7 @@ from .decentralized import (  # noqa: F401
 )
 from .gradient_allreduce import GradientAllReduceAlgorithm  # noqa: F401
 from .q_adam import QAdamAlgorithm, QAdamOptState  # noqa: F401
+from .zero import ZeroOptimizerAlgorithm  # noqa: F401
 
 #: Families the autotuner may switch between at a check-in (stateless,
 #: replicated, trainer-owned-optimizer algorithms only — swapping them never

@@ -72,6 +72,10 @@ class Algorithm:
     replicated_params: bool = True
     #: True when the algorithm provides its own optimizer update (QAdam).
     owns_optimizer: bool = False
+    #: True when the optimizer state is sharded over the comm axes (ZeRO-1):
+    #: params stay replicated but opt_state is built per rank inside
+    #: shard_map via ``init_optimizer_state_sharded(ctx, params)``.
+    sharded_opt_state: bool = False
     #: Alignment for bucket padding (compressed ops need world_size).
     bucket_alignment: int = 1
     #: Hierarchical (intra-node then inter-node) communication.

@@ -1,0 +1,131 @@
+"""ZeRO-1: optimizer-state sharding over the data-parallel axes.
+
+Additive capability — the reference has no ZeRO/FSDP analog (SURVEY.md §2.3
+lists it as absent; its closest relative is the flat-param
+``contrib/fused_optimizer.py``).  On TPU this is the natural next step past
+plain DP: optimizer state is the largest per-chip memory consumer for Adam
+(2× params in f32), and the bucket flat buffers already partition evenly
+across ranks (world-size alignment), so the classic ZeRO-1 dance maps to two
+XLA collectives per bucket:
+
+    reduce_scatter(grads)  ->  shard-local optimizer update  ->  all_gather(params)
+
+which costs exactly the same bytes on the wire as the allreduce it replaces
+(an allreduce IS a reduce-scatter + all-gather), while storing only
+``1/world_size`` of the optimizer state per chip.
+
+The wrapped optax transformation must be *elementwise* (adam, adamw, sgd,
+rmsprop, ...): the update for element ``i`` may depend only on gradient /
+param / state values at ``i``, because each rank updates its own flat chunk
+independently.  Global-norm gradient clipping — the one norm-coupled
+transform everyone needs — is built in (``clip_global_norm``): the norm of
+the *averaged* gradient is assembled with one extra scalar psum over the
+already-sharded chunks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..communication import ReduceOp
+from .base import Algorithm, AlgorithmContext
+
+
+class ZeroOptimizerAlgorithm(Algorithm):
+    """ZeRO stage-1 data parallelism: replicated params, sharded optimizer
+    state, reduce-scatter gradient averaging.
+
+    Args:
+        optimizer: an elementwise optax ``GradientTransformation``
+            (default ``optax.adam(1e-3)``).  Its state is built per flat
+            bucket *chunk* — each rank stores only its ``1/world_size``
+            slice.
+        clip_global_norm: optional max global grad norm.  Computed on the
+            averaged gradient (post reduce-scatter) with a scalar psum, so
+            every rank applies the identical scale — the distributed analog
+            of ``optax.clip_by_global_norm``.
+    """
+
+    owns_optimizer = True
+    sharded_opt_state = True
+
+    def __init__(
+        self,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        clip_global_norm: Optional[float] = None,
+        hierarchical: bool = False,
+    ):
+        self.optimizer = optimizer if optimizer is not None else optax.adam(1e-3)
+        self.clip_global_norm = clip_global_norm
+        self.hierarchical = hierarchical
+
+    def tensors_to_buckets(self, decl_buckets, named_params, world_size):
+        from ..bucket import BucketPlan
+
+        # world-size alignment so every bucket splits into equal rank chunks
+        # (the same alignment the compressed scatter-gather ops use,
+        # reference bytegrad.py:38-43)
+        return BucketPlan.from_declaration_buckets(
+            decl_buckets, named_params, alignment=world_size
+        )
+
+    # ---- chunk helpers ---------------------------------------------------
+
+    @staticmethod
+    def _chunk_size(ctx: AlgorithmContext, flat) -> int:
+        n = ctx.comm.nranks()
+        assert flat.shape[0] % n == 0, (
+            f"bucket numel {flat.shape[0]} not divisible by world size {n}"
+        )
+        return flat.shape[0] // n
+
+    def _my_chunk(self, ctx: AlgorithmContext, flat):
+        size = self._chunk_size(ctx, flat)
+        start = ctx.comm.rank() * size
+        return jax.lax.dynamic_slice(flat, (start,), (size,))
+
+    # ---- optimizer contract ---------------------------------------------
+
+    def init_optimizer_state_sharded(self, ctx: AlgorithmContext, params):
+        """Per-rank optimizer state: one optax state per bucket, built for
+        that rank's flat chunk only (runs inside ``shard_map``)."""
+        flats = ctx.plan.flatten_tree(params)
+        return tuple(self.optimizer.init(self._my_chunk(ctx, f)) for f in flats)
+
+    def init_optimizer_state(self, params):  # pragma: no cover - guard
+        raise NotImplementedError(
+            "ZeroOptimizerAlgorithm state is sharded; the trainer must call "
+            "init_optimizer_state_sharded inside shard_map"
+        )
+
+    def optimizer_update(self, ctx: AlgorithmContext, params, grads, opt_state,
+                         algo_state, step):
+        gflats = ctx.plan.flatten_tree(grads)
+        pflats = ctx.plan.flatten_tree(params)
+        # grad averaging and sharding in one collective per bucket
+        gchunks = [ctx.comm.reduce_scatter(gf, ReduceOp.AVG) for gf in gflats]
+
+        if self.clip_global_norm is not None:
+            # ||avg grad||² = psum of each rank's chunk contributions
+            # (bucket padding is zeros and does not perturb the norm)
+            ssq = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gchunks
+            )
+            gnorm = jnp.sqrt(ctx.comm.allreduce(ssq, ReduceOp.SUM))
+            scale = jnp.minimum(1.0, self.clip_global_norm / (gnorm + 1e-12))
+            gchunks = [(g * scale.astype(g.dtype)) for g in gchunks]
+
+        new_pflats, new_states = [], []
+        for gchunk, pf, st in zip(gchunks, pflats, opt_state):
+            pchunk = self._my_chunk(ctx, pf)
+            updates, st = self.optimizer.update(gchunk, st, pchunk)
+            pchunk = optax.apply_updates(pchunk, updates)
+            # re-replicate the updated params (rank chunks in rank order)
+            new_pflats.append(ctx.comm.allgather(pchunk, tiled=True))
+            new_states.append(st)
+        new_params = ctx.plan.unflatten_tree(new_pflats, params)
+        return new_params, tuple(new_states), algo_state

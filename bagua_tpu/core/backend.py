@@ -81,6 +81,7 @@ class BaguaTrainer:
         tp_param_dim=None,
         pp_axis: Optional[str] = None,
         pp_param_dim=None,
+        accum_steps: int = 1,
     ):
         """``expert_axis``: mesh axis carrying expert parallelism (MoE).
         Expert params are sharded over it and excluded from the data-parallel
@@ -115,7 +116,14 @@ class BaguaTrainer:
         data axes only, like tp slices.  Replicated leaves (embedding,
         head) get PARTIAL grads — each stage contributes only its own use —
         so they are scaled by pp_size and the bucket allreduce DOES span
-        pp, turning its average into the required sum."""
+        pp, turning its average into the required sum.
+
+        ``accum_steps``: gradient accumulation.  The per-rank batch leading
+        dimension must be ``accum_steps × microbatch``; the step scans the
+        microbatches (``lax.scan``, so the backward is compiled once),
+        averaging losses and gradients before any algorithm stage runs —
+        communication still happens once per step, on the accumulated
+        gradient, exactly as if the full batch had fit in memory."""
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.algorithm = algorithm
@@ -202,6 +210,9 @@ class BaguaTrainer:
             if a is not None
         )
         self.world_size = mesh_axis_size(mesh, self.comm_axes)
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self.accum_steps = int(accum_steps)
         self.bucket_bytes = bucket_bytes or env.get_default_bucket_size()
         self.model_name = model_name
         self.donate = donate
@@ -218,6 +229,16 @@ class BaguaTrainer:
         self._phase = 0
 
         self.autotune = env.get_autotune_level() >= 1 if autotune is None else autotune
+        if self.autotune and algorithm.sharded_opt_state:
+            # a rebucket would orphan the per-bucket chunk states (they are
+            # keyed on bucket boundaries, unlike the param-shaped states of
+            # the other families)
+            logger.warning(
+                "autotune disabled: %s shards optimizer state per bucket, "
+                "which autotune rebucketing would invalidate",
+                type(algorithm).__name__,
+            )
+            self.autotune = False
         self._autotune_client = None
         self._autotune_failures = 0
         self._autotune_completed = not self.autotune
@@ -322,6 +343,11 @@ class BaguaTrainer:
     def rebucket(self, decl_buckets) -> None:
         """Apply an autotune bucketing suggestion (reference
         distributed.py:443-502 ``_bagua_reset_algorithm_buckets``)."""
+        if self.algorithm.sharded_opt_state:
+            raise ValueError(
+                "cannot rebucket: the algorithm's optimizer state is sharded "
+                "per bucket and would be invalidated by new bucket boundaries"
+            )
         self._plan = self.algorithm.tensors_to_buckets(
             decl_buckets, self._named_params, self.world_size
         )
@@ -343,6 +369,14 @@ class BaguaTrainer:
             opt_init = algo.init_optimizer_state
         else:
             opt_init = self.optimizer.init
+
+        if algo.sharded_opt_state and (
+            self.expert_axis is not None or self._shard_axis is not None
+        ):
+            raise NotImplementedError(
+                "sharded_opt_state with expert/tensor/pipeline parallelism "
+                "is not supported yet"
+            )
 
         if self.expert_axis is not None:
             # everything is stacked per ep-rank (leading axis sharded over
@@ -371,6 +405,24 @@ class BaguaTrainer:
             return TrainState(
                 jnp.zeros((), jnp.int32), p_stacked, opt_state, algo_state
             )
+
+        if algo.replicated_params and algo.sharded_opt_state:
+            # ZeRO-1 layout: params replicated, optimizer state sharded over
+            # the comm axes.  The stacked leading axis makes each rank's
+            # chunk-state addressable with the same spec machinery as the
+            # gossip algorithms' per-rank state.
+            def init_fn(p):
+                a = algo.init_state(ctx, p)
+                o = algo.init_optimizer_state_sharded(ctx, p)
+                stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+                return stack(o), stack(a)
+
+            ospec = P(self.comm_axes)
+            opt_state, algo_state = jax.jit(
+                shard_map(init_fn, mesh=mesh, in_specs=(P(),),
+                          out_specs=(ospec, ospec), check_vma=False)
+            )(params)
+            return TrainState(jnp.zeros((), jnp.int32), params, opt_state, algo_state)
 
         if algo.replicated_params:
             opt_state = jax.jit(opt_init)(params)
@@ -426,6 +478,11 @@ class BaguaTrainer:
         # per-shard state is stacked (leading rank axis) for gossip
         # algorithms and for expert parallelism
         stacked = (not replicated) or expert is not None
+        # ZeRO-1: only opt/algo state carries the per-rank stacked axis;
+        # params stay replicated
+        opt_stacked = replicated and algo.sharded_opt_state and expert is None
+        _unstack = lambda t: jax.tree.map(lambda x: x[0], t)
+        _stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
         # expert grads average over dp (+sp: partial-sequence contributions)
         # but never over ep, where experts differ
         expert_dp = tuple(
@@ -438,13 +495,43 @@ class BaguaTrainer:
             opt_state = state.opt_state
             algo_state = state.algo_state
             if stacked:
-                unstack = lambda t: jax.tree.map(lambda x: x[0], t)
                 params, opt_state, algo_state = (
-                    unstack(params), unstack(opt_state), unstack(algo_state)
+                    _unstack(params), _unstack(opt_state), _unstack(algo_state)
                 )
+            elif opt_stacked:
+                opt_state, algo_state = _unstack(opt_state), _unstack(algo_state)
             step = state.step
 
-            loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+            if self.accum_steps > 1:
+                accum = self.accum_steps
+
+                def reshape_mb(x):
+                    if x.shape[0] % accum:
+                        raise ValueError(
+                            f"batch leading dim {x.shape[0]} is not divisible "
+                            f"by accum_steps={accum}"
+                        )
+                    return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+                microbatches = jax.tree.map(reshape_mb, batch)
+
+                def micro_step(carry, mb):
+                    loss_sum, grad_sum = carry
+                    l, g = jax.value_and_grad(self.loss_fn)(params, mb)
+                    return (loss_sum + l, jax.tree.map(jnp.add, grad_sum, g)), None
+
+                # carry dtype must match micro_step's promoted loss dtype
+                mb0 = jax.tree.map(lambda x: x[0], microbatches)
+                loss_dtype = jax.eval_shape(self.loss_fn, params, mb0).dtype
+                zero = (
+                    jnp.zeros((), loss_dtype),
+                    jax.tree.map(jnp.zeros_like, params),
+                )
+                (loss, grads), _ = jax.lax.scan(micro_step, zero, microbatches)
+                loss = loss / accum
+                grads = jax.tree.map(lambda g: g / accum, grads)
+            else:
+                loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
             if self.pp_axis is not None and mesh.shape[self.pp_axis] > 1:
                 # replicated-leaf grads are PARTIAL per pipeline stage: the
                 # bucket allreduce spans pp, so prescaling by pp_size turns
@@ -505,10 +592,11 @@ class BaguaTrainer:
 
             loss = ctx.comm.allreduce(loss, ReduceOp.AVG)
             if stacked:
-                stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
                 params, opt_state, algo_state = (
-                    stack(params), stack(opt_state), stack(algo_state)
+                    _stack(params), _stack(opt_state), _stack(algo_state)
                 )
+            elif opt_stacked:
+                opt_state, algo_state = _stack(opt_state), _stack(algo_state)
             return TrainState(state.step + 1, params, opt_state, algo_state), loss
 
         if expert is not None:
@@ -520,6 +608,10 @@ class BaguaTrainer:
                 step=P(), params=self._param_specs,
                 opt_state=self._opt_specs, algo_state=P(),
             )
+        elif opt_stacked:
+            sspec = P(self.comm_axes)
+            state_specs = TrainState(step=P(), params=P(), opt_state=sspec,
+                                     algo_state=sspec)
         else:
             pspec = P() if replicated else P(dp)
             state_specs = TrainState(step=P(), params=pspec, opt_state=pspec,

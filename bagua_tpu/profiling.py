@@ -42,21 +42,38 @@ def profile_steps() -> Tuple[int, int]:
         return 2, 5
 
 
+# jax allows only one profile at a time; track the owner (a StepProfiler or
+# the trace() context manager) so the other entry point skips its turn
+# instead of crashing
+_TRACE_OWNER: Optional[object] = None
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
-    """Capture a ``jax.profiler`` trace of the enclosed region."""
+    """Capture a ``jax.profiler`` trace of the enclosed region.
+
+    If a trace is already running (e.g. trainer auto-capture via
+    ``BAGUA_PROFILE_DIR`` has its step window open), the region runs
+    untraced with a warning — jax allows only one profile at a time."""
+    global _TRACE_OWNER
     import jax
 
+    if _TRACE_OWNER is not None:
+        logger.warning(
+            "profiling.trace(%s): another trace is active; running untraced",
+            log_dir,
+        )
+        yield
+        return
+    token = object()
+    _TRACE_OWNER = token
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
-
-
-# jax allows only one profile at a time; track the owning StepProfiler so
-# a second trainer in the same process waits its turn instead of crashing
-_TRACE_OWNER: Optional["StepProfiler"] = None
+        if _TRACE_OWNER is token:
+            _TRACE_OWNER = None
 
 
 class StepProfiler:

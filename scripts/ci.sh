@@ -16,8 +16,12 @@ bad = []
 for p in pathlib.Path("bagua_tpu").rglob("*.py"):
     tree = ast.parse(p.read_text())
     for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module == "torch":
-            bad.append(str(p))
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "torch":
+                bad.append(str(p))
+        elif isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] == "torch" for a in node.names):
+                bad.append(str(p))
 if bad:
     sys.exit(f"torch imports in the TPU package: {bad}")
 print("import graph clean")

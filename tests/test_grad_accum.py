@@ -1,0 +1,108 @@
+"""Gradient accumulation (``BaguaTrainer(accum_steps=k)``).
+
+Equivalence invariant: with a mean-reduced loss and equal microbatch sizes,
+accumulating k microbatches must produce exactly the step a single pass over
+the full batch would have produced (mean of microbatch means == full-batch
+mean), so the two trainings match elementwise — on top of any algorithm,
+since accumulation runs before the algorithm stages.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu import BaguaTrainer
+from bagua_tpu.algorithms import (
+    GradientAllReduceAlgorithm,
+    QAdamAlgorithm,
+    ZeroOptimizerAlgorithm,
+)
+from bagua_tpu.models import MLP
+
+N = 8
+DIM = 12
+NCLASS = 10
+
+
+def _loss_fn(model):
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+
+    return loss_fn
+
+
+def _data(steps, batch_rows, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(steps, batch_rows, DIM)).astype(np.float32)
+    ys = rng.integers(0, NCLASS, size=(steps, batch_rows)).astype(np.int32)
+    return xs, ys
+
+
+def _train(trainer, params, xs, ys):
+    state = trainer.init(params)
+    losses = []
+    for s in range(xs.shape[0]):
+        state, loss = trainer.train_step(state, {"x": xs[s], "y": ys[s]})
+        losses.append(float(loss))
+    return state, losses
+
+
+def _make(algo_factory, optimizer):
+    return lambda accum: BaguaTrainer(
+        _loss_fn(MODEL), optimizer, algo_factory(),
+        bucket_bytes=256, accum_steps=accum,
+    )
+
+
+MODEL = MLP(features=(16, NCLASS))
+
+
+@pytest.mark.parametrize(
+    "algo_factory,optimizer,tol",
+    [
+        (GradientAllReduceAlgorithm, optax.sgd(0.1), 2e-5),
+        (lambda: ZeroOptimizerAlgorithm(optax.adam(1e-2)), None, 2e-5),
+        # QAdam crosses its warmup boundary mid-run; the compressed phase
+        # quantizes momentum, where a 1-ulp input difference can flip a
+        # quantization level — hence the looser tolerance
+        (lambda: QAdamAlgorithm(warmup_steps=2, lr=1e-2), None, 1e-3),
+    ],
+    ids=["gradient_allreduce", "zero", "qadam"],
+)
+def test_accum_equals_full_batch(algo_factory, optimizer, tol):
+    params = MODEL.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
+    accum = 4
+    xs, ys = _data(steps=4, batch_rows=N * 2 * accum)
+
+    make = _make(algo_factory, optimizer)
+    st_full, losses_full = _train(make(1), params, xs, ys)
+    st_acc, losses_acc = _train(make(accum), params, xs, ys)
+
+    np.testing.assert_allclose(losses_acc, losses_full, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(st_acc.params), jax.tree.leaves(st_full.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=tol, atol=tol)
+
+
+def test_rejects_indivisible_batch():
+    params = MODEL.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
+    trainer = BaguaTrainer(
+        _loss_fn(MODEL), optax.sgd(0.1), GradientAllReduceAlgorithm(),
+        accum_steps=3,
+    )
+    state = trainer.init(params)
+    xs, ys = _data(steps=1, batch_rows=N * 4)  # 4 rows/rank, not divisible by 3
+    with pytest.raises(ValueError, match="not divisible"):
+        trainer.train_step(state, {"x": xs[0], "y": ys[0]})
+
+
+def test_rejects_bad_accum_steps():
+    with pytest.raises(ValueError):
+        BaguaTrainer(
+            _loss_fn(MODEL), optax.sgd(0.1), GradientAllReduceAlgorithm(),
+            accum_steps=0,
+        )

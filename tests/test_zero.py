@@ -1,0 +1,139 @@
+"""ZeRO-1 optimizer-state sharding (additive; no reference counterpart —
+SURVEY.md §2.3 lists ZeRO/FSDP as absent from the reference).
+
+Golden-model equivalence: reduce_scatter + shard-local adam + all_gather must
+equal replicated adam over allreduce-averaged gradients (an allreduce IS
+reduce-scatter + all-gather), so ZeRO training == plain DP training
+elementwise.  Plus layout checks: each rank must hold only 1/world_size of
+the optimizer state.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu import BaguaTrainer
+from bagua_tpu.algorithms import GradientAllReduceAlgorithm, ZeroOptimizerAlgorithm
+from bagua_tpu.models import MLP
+
+N = 8
+BATCH_PER_RANK = 4
+DIM = 12
+NCLASS = 10
+
+
+def _data(steps=5, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(steps, N * BATCH_PER_RANK, DIM)).astype(np.float32)
+    ys = rng.integers(0, NCLASS, size=(steps, N * BATCH_PER_RANK)).astype(np.int32)
+    return xs, ys
+
+
+def _loss_fn(model):
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+
+    return loss_fn
+
+
+def _train(trainer, params, xs, ys):
+    state = trainer.init(params)
+    for s in range(xs.shape[0]):
+        state, loss = trainer.train_step(state, {"x": xs[s], "y": ys[s]})
+    return state, float(loss)
+
+
+def test_matches_replicated_adam():
+    model = MLP(features=(16, NCLASS))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
+    loss_fn = _loss_fn(model)
+    xs, ys = _data()
+
+    zero = BaguaTrainer(
+        loss_fn, None, ZeroOptimizerAlgorithm(optax.adam(1e-2)),
+        bucket_bytes=256,
+    )
+    st_zero, _ = _train(zero, params, xs, ys)
+
+    plain = BaguaTrainer(
+        loss_fn, optax.adam(1e-2), GradientAllReduceAlgorithm(),
+        bucket_bytes=256,
+    )
+    st_plain, _ = _train(plain, params, xs, ys)
+
+    for a, b in zip(jax.tree.leaves(st_zero.params), jax.tree.leaves(st_plain.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+
+def test_optimizer_state_is_sharded():
+    model = MLP(features=(16, NCLASS))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
+    trainer = BaguaTrainer(
+        _loss_fn(model), None, ZeroOptimizerAlgorithm(optax.adam(1e-2)),
+        bucket_bytes=256,
+    )
+    state = trainer.init(params)
+
+    total_padded = sum(b.padded_numel for b in trainer._plan.buckets)
+    # adam: exp_avg (mu) + exp_avg_sq (nu) per bucket chunk; the stacked
+    # global view is [N, chunk] so each rank materializes chunk = padded/N
+    for bucket_state in state.opt_state:
+        adam_state = bucket_state[0]  # ScaleByAdamState
+        assert adam_state.mu.ndim == 2  # [N, chunk] stacked global view
+    chunk_elems = sum(bs[0].mu.shape[1] for bs in state.opt_state)
+    assert chunk_elems == total_padded // N
+
+    # each per-rank shard holds only its chunk
+    for bs in state.opt_state:
+        shard_shapes = {s.data.shape for s in bs[0].mu.addressable_shards}
+        assert all(s[0] == 1 for s in shard_shapes)
+
+
+def test_clip_global_norm_matches_optax():
+    model = MLP(features=(16, NCLASS))
+    params = model.init(jax.random.PRNGKey(2), jnp.zeros((1, DIM)))["params"]
+    loss_fn = _loss_fn(model)
+    xs, ys = _data(steps=4, seed=7)
+    clip = 0.05  # small enough that clipping actually engages
+
+    zero = BaguaTrainer(
+        loss_fn, None,
+        ZeroOptimizerAlgorithm(optax.adam(1e-2), clip_global_norm=clip),
+        bucket_bytes=256,
+    )
+    st_zero, _ = _train(zero, params, xs, ys)
+
+    # golden: full-batch chained clip->adam (same averaged gradient)
+    opt = optax.chain(optax.clip_by_global_norm(clip), optax.adam(1e-2))
+    gp, gopt = params, opt.init(params)
+
+    @jax.jit
+    def g_step(p, o, b):
+        g = jax.grad(loss_fn)(p, b)
+        u, o = opt.update(g, o, p)
+        return optax.apply_updates(p, u), o
+
+    for s in range(xs.shape[0]):
+        gp, gopt = g_step(gp, gopt, {"x": xs[s], "y": ys[s]})
+
+    for a, b in zip(jax.tree.leaves(st_zero.params), jax.tree.leaves(gp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+
+def test_rejects_model_parallel_axes():
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    model = MLP(features=(8, NCLASS))
+    mesh = build_mesh({"dp": 4, "ep": 2})
+    with pytest.raises(NotImplementedError):
+        trainer = BaguaTrainer(
+            _loss_fn(model), None, ZeroOptimizerAlgorithm(),
+            mesh=mesh, expert_axis="ep",
+        )
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
+        trainer.init(params)
