@@ -118,6 +118,13 @@ class BaguaTrainer:
         so they are scaled by pp_size and the bucket allreduce DOES span
         pp, turning its average into the required sum.
 
+        ``tp_axis`` and ``pp_axis`` compose (3-D parallelism over
+        dp × pp × tp): stage-stacked block kernels that are also
+        tensor-parallel carry both placements — ``P(pp, ..., tp, ...)`` —
+        with the tp dim (reported in per-layer coordinates) shifted past
+        the leading stage dim.  Bucketed (dense) grads still communicate
+        over dp + pp only; tp stays out of the bucket plan entirely.
+
         ``accum_steps``: gradient accumulation.  The per-rank batch leading
         dimension must be ``accum_steps × microbatch``; the step scans the
         microbatches (``lax.scan``, so the backward is compiled once),
@@ -152,10 +159,6 @@ class BaguaTrainer:
             if expert_axis is not None:
                 raise NotImplementedError(
                     f"combining {label} with expert_axis is not supported yet"
-                )
-            if tp_axis is not None and pp_axis is not None:
-                raise NotImplementedError(
-                    "combining tp_axis with pp_axis is not supported yet"
                 )
             if not algorithm.replicated_params:
                 raise NotImplementedError(
@@ -228,6 +231,11 @@ class BaguaTrainer:
         self._step_counter = 0
         self._phase = 0
 
+        # configured instances by family name, so an autotune family switch
+        # that returns to the user's family restores THEIR settings
+        name = getattr(algorithm, "name", None)
+        self._user_algorithms = {name: algorithm} if name else {}
+
         self.autotune = env.get_autotune_level() >= 1 if autotune is None else autotune
         if self.autotune and algorithm.sharded_opt_state:
             # a rebucket would orphan the per-bucket chunk states (they are
@@ -294,22 +302,36 @@ class BaguaTrainer:
 
     @property
     def _shard_axis(self) -> Optional[str]:
-        """The model-parallel axis whose param slices bypass the bucket
-        plan (tp or pp — mutually exclusive)."""
+        """Truthy when a model-parallel axis (tp and/or pp) is present;
+        param slices of such leaves bypass the bucket plan."""
         return self.tp_axis if self.tp_axis is not None else self.pp_axis
 
-    def _shard_dim(self, name: str) -> Optional[int]:
-        if self.tp_axis is not None and self._tp_param_dim is not None:
-            return self._tp_param_dim(name)
+    def _shard_entries(self, name: str) -> Tuple[Tuple[int, str], ...]:
+        """((dim, axis), ...) placements for a param leaf — pp stage
+        stacking at its reported dim, tp slicing at the tp dim.  When a leaf
+        is both pp-stacked and tp-sharded (3-D parallelism), the tp dim —
+        reported by ``tp_param_dim`` in per-layer coordinates — shifts one
+        right past the leading stage dim."""
+        entries = []
         if self.pp_axis is not None and self._pp_param_dim is not None:
-            return self._pp_param_dim(name)
-        return None
+            d = self._pp_param_dim(name)
+            if d is not None:
+                entries.append((d, self.pp_axis))
+        if self.tp_axis is not None and self._tp_param_dim is not None:
+            d = self._tp_param_dim(name)
+            if d is not None:
+                shift = 1 if entries else 0
+                entries.append((d + shift, self.tp_axis))
+        return tuple(entries)
+
+    def _is_sharded(self, name: str) -> bool:
+        return bool(self._shard_entries(name))
 
     def _build_plan(self, params) -> BucketPlan:
         candidates = [
             p for p in build_params(params)
             if not self._is_expert_name(p.name)
-            and self._shard_dim(p.name) is None
+            and not self._is_sharded(p.name)
         ]
         named = self.algorithm.init_tensors(candidates)
         self._named_params = named
@@ -319,12 +341,16 @@ class BaguaTrainer:
 
     def _tp_param_spec_tree(self, params):
         """Per-leaf PartitionSpecs: tp/pp leaves sharded along their
-        reported dim, everything else replicated."""
+        reported dims (both, for 3-D-parallel stacked-and-sliced kernels),
+        everything else replicated."""
         def leaf_spec(path, leaf):
-            dim = self._shard_dim(_name_of_path(path))
-            if dim is None:
+            entries = self._shard_entries(_name_of_path(path))
+            if not entries:
                 return P()
-            return P(*([None] * dim + [self._shard_axis]))
+            axes = [None] * (max(d for d, _ in entries) + 1)
+            for d, ax in entries:
+                axes[d] = ax
+            return P(*axes)
 
         return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
@@ -539,7 +565,7 @@ class BaguaTrainer:
                 pp_size = mesh.shape[self.pp_axis]
 
                 def pp_dense_grad(path, g):
-                    if self._shard_dim(_name_of_path(path)) is not None:
+                    if self._is_sharded(_name_of_path(path)):
                         return g
                     return g * pp_size
 
@@ -572,10 +598,7 @@ class BaguaTrainer:
                 tp_dp = expert_dp
 
                 def tp_grad(path, g):
-                    if (
-                        self._shard_dim(_name_of_path(path)) is None
-                        or not tp_dp
-                    ):
+                    if not self._is_sharded(_name_of_path(path)) or not tp_dp:
                         return g
                     return jax.lax.pmean(g, tp_dp)
 
@@ -756,9 +779,16 @@ class BaguaTrainer:
         ):
             return
         logger.info("autotune: switching algorithm %s -> %s", current, target)
-        self.algorithm = SWITCHABLE_ALGORITHMS[target](
-            bool(recommended.is_hierarchical_reduce)
-        )
+        if target in self._user_algorithms:
+            # switching BACK to a family the user configured: reuse their
+            # instance so settings beyond the search space (comm_dtype,
+            # average, ...) survive the round trip
+            self.algorithm = self._user_algorithms[target]
+            self.algorithm.hierarchical = bool(recommended.is_hierarchical_reduce)
+        else:
+            self.algorithm = SWITCHABLE_ALGORITHMS[target](
+                bool(recommended.is_hierarchical_reduce)
+            )
         if not recommended.buckets:
             # rebuild the plan under the new family's alignment (ByteGrad
             # pads buckets to the world size); skipped when the caller is

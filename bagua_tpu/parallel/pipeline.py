@@ -154,23 +154,36 @@ def pp_lm_loss_fn(model: PipelinedTransformerLM):
     return loss_fn
 
 
-def globalize_pp_params(params, rng, pp_size: int):
+def globalize_pp_params(params, rng, pp_size: int, tp_size: int = 1,
+                        tp_param_dim=None):
     """Expand LOCAL stage stacks ``[L/pp, ...]`` to GLOBAL ``[L, ...]``.
 
     Norm scales are re-expanded as ones; kernels are re-drawn lecun-normal
-    over their per-layer contracting dims (layer dim 0 excluded).
+    over their per-layer contracting dims (layer dim 0 excluded).  With
+    ``tp_size > 1`` (3-D parallelism: the blocks also carry tensor-parallel
+    kernels) each tp leaf's sharded dim — reported by ``tp_param_dim`` in
+    per-layer coordinates, shifted past the stage dim — is expanded to its
+    global width as well, and the redraw uses the GLOBAL fan-in.
     """
     from ..models.transformer import tp_param_fan_in_dims
     from ..tensor import _name_of_path
     from .tensor_parallel import redraw_lecun
 
+    if tp_param_dim is None and tp_size > 1:
+        from ..models.transformer import tp_param_dim as _default_tp_dim
+
+        tp_param_dim = _default_tp_dim
+
     def fix(path, leaf):
         name = _name_of_path(path)
-        if pp_param_dim(name) is None or pp_size == 1:
+        if pp_param_dim(name) is None or (pp_size == 1 and tp_size == 1):
             return leaf
-        shape = (leaf.shape[0] * pp_size,) + leaf.shape[1:]
+        shape = [leaf.shape[0] * pp_size, *leaf.shape[1:]]
         if name.endswith(".scale"):  # norm scales: ones
-            return jnp.ones(shape, leaf.dtype)
+            return jnp.ones(tuple(shape), leaf.dtype)
+        tpd = tp_param_dim(name) if tp_size > 1 else None
+        if tpd is not None:
+            shape[tpd + 1] = shape[tpd + 1] * tp_size
         nonlocal rng
         rng, sub = jax.random.split(rng)
         # per-layer kernels: contracting dims from the tp table, shifted
@@ -180,6 +193,6 @@ def globalize_pp_params(params, rng, pp_size: int):
             tuple(ax + 1 for ax in inner) if inner is not None
             else tuple(range(1, len(shape) - 1))
         )
-        return redraw_lecun(sub, shape, contracting, leaf.dtype)
+        return redraw_lecun(sub, tuple(shape), contracting, leaf.dtype)
 
     return jax.tree_util.tree_map_with_path(fix, params)

@@ -66,14 +66,16 @@ def trace(log_dir: str):
         yield
         return
     token = object()
-    _TRACE_OWNER = token
     jax.profiler.start_trace(log_dir)
+    _TRACE_OWNER = token  # only own it once start_trace succeeded
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
-        if _TRACE_OWNER is token:
-            _TRACE_OWNER = None
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            if _TRACE_OWNER is token:
+                _TRACE_OWNER = None
 
 
 class StepProfiler:

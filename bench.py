@@ -30,6 +30,9 @@ FAMILY_FLOORS = {
     "decentralized": 150.0,
     "low_precision_decentralized": 115.0,
     "async": 190.0,
+    # no reference counterpart (ZeRO is additive); gated against the plain
+    # allreduce floor since it moves the same bytes per step
+    "zero": 185.0,
 }
 BATCH_PER_DEVICE = 32  # the reference CI floor was gated at batch 32
 IMAGE_SIZE = 224
@@ -46,6 +49,7 @@ def _algorithms():
     )
     from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
     from bagua_tpu.algorithms.q_adam import QAdamAlgorithm
+    from bagua_tpu.algorithms.zero import ZeroOptimizerAlgorithm
 
     return {
         "gradient_allreduce": lambda: GradientAllReduceAlgorithm(hierarchical=False),
@@ -58,6 +62,7 @@ def _algorithms():
             hierarchical=False
         ),
         "async": lambda: AsyncModelAverageAlgorithm(sync_interval_ms=100),
+        "zero": lambda: ZeroOptimizerAlgorithm(optax.sgd(0.1, momentum=0.9)),
     }
 
 

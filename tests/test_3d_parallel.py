@@ -1,0 +1,139 @@
+"""3-D parallelism: dp × pp × tp composed in one jitted step.
+
+Additive — the reference has neither TP nor PP (SURVEY.md §2.3).  Golden
+pattern as in tests/test_{tensor,pipeline}_parallel.py: the same global
+params trained with the full 3-D mesh must match the sequential (pp=1,
+tp=1) run, validating the composed placements (stage-stacked AND tp-sliced
+kernels), the pp_size prescale of dense grads, and tp exclusion from the
+bucket plan.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
+from bagua_tpu.core.backend import BaguaTrainer
+from bagua_tpu.models.transformer import TransformerConfig
+from bagua_tpu.parallel.mesh import build_mesh
+from bagua_tpu.parallel.pipeline import (
+    PipelinedTransformerLM,
+    globalize_pp_params,
+    pp_lm_loss_fn,
+)
+
+PP, TP = 2, 2
+
+
+def _cfg(tp: int):
+    return TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=4, d_ff=64,
+        max_seq_len=8, dtype=jnp.float32,
+        tp_axis="tp" if tp > 1 else None, tp_size=tp,
+    )
+
+
+def _global_params(key=0):
+    cfg = _cfg(TP)
+    local = PipelinedTransformerLM(cfg, pp_size=PP).init(
+        jax.random.PRNGKey(key), jnp.zeros((2, cfg.max_seq_len + 1), jnp.int32)
+    )["params"]
+    return globalize_pp_params(local, jax.random.PRNGKey(key + 1), PP,
+                               tp_size=TP)
+
+
+def test_3d_one_step_matches_sequential():
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 9), 0, 64)
+    params = _global_params()
+
+    # golden: sequential (pp=1, tp=1) on one device, same global params
+    seq_model = PipelinedTransformerLM(_cfg(1), pp_size=1)
+    t1 = BaguaTrainer(
+        pp_lm_loss_fn(seq_model), optax.sgd(0.1), GradientAllReduceAlgorithm(),
+        mesh=build_mesh({"dp": 1}, jax.devices()[:1]), autotune=False,
+    )
+    s1 = t1.init(params)
+    s1, loss1 = t1.train_step(s1, t1.shard_batch({"tokens": tokens}))
+
+    model = PipelinedTransformerLM(_cfg(TP), pp_size=PP, n_microbatches=2)
+    t3d = BaguaTrainer(
+        pp_lm_loss_fn(model), optax.sgd(0.1), GradientAllReduceAlgorithm(),
+        mesh=build_mesh({"dp": 1, "pp": PP, "tp": TP},
+                        jax.devices()[:PP * TP]),
+        pp_axis="pp", tp_axis="tp", autotune=False,
+    )
+    s3d = t3d.init(params)
+    s3d, loss3d = t3d.train_step(s3d, t3d.shard_batch({"tokens": tokens}))
+
+    np.testing.assert_allclose(float(loss1), float(loss3d), atol=1e-5)
+    flat1 = jax.tree_util.tree_leaves_with_path(t1.unstack_params(s1))
+    flat3d = dict(jax.tree_util.tree_leaves_with_path(t3d.unstack_params(s3d)))
+    for path, leaf in flat1:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat3d[path]), atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_3d_dp_trains():
+    """dp=2 × pp=2 × tp=2 over all 8 devices: loss decreases."""
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (8, 9), 0, 64)
+    params = _global_params(key=5)
+    model = PipelinedTransformerLM(_cfg(TP), pp_size=PP, n_microbatches=2)
+    trainer = BaguaTrainer(
+        pp_lm_loss_fn(model), optax.adam(1e-2), GradientAllReduceAlgorithm(),
+        mesh=build_mesh({"dp": 2, "pp": PP, "tp": TP}),
+        pp_axis="pp", tp_axis="tp", autotune=False,
+    )
+    state = trainer.init(params)
+    batch = trainer.shard_batch({"tokens": tokens})
+    losses = []
+    for _ in range(15):
+        state, loss = trainer.train_step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_3d_checkpoint_roundtrip(tmp_path):
+    """Save/restore with doubly-sharded (stage-stacked + tp-sliced) leaves."""
+    from bagua_tpu.checkpoint import BaguaCheckpointManager
+
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (8, 9), 0, 64)
+    params = _global_params(key=7)
+    model = PipelinedTransformerLM(_cfg(TP), pp_size=PP, n_microbatches=2)
+
+    def new_trainer():
+        return BaguaTrainer(
+            pp_lm_loss_fn(model), optax.adam(1e-2),
+            GradientAllReduceAlgorithm(),
+            mesh=build_mesh({"dp": 2, "pp": PP, "tp": TP}),
+            pp_axis="pp", tp_axis="tp", autotune=False,
+        )
+
+    batch = new_trainer().shard_batch({"tokens": tokens})
+    t0 = new_trainer()
+    s = t0.init(params)
+    ref = []
+    for _ in range(4):
+        s, loss = t0.train_step(s, batch)
+        ref.append(float(loss))
+
+    t1 = new_trainer()
+    s1 = t1.init(params)
+    for _ in range(2):
+        s1, _ = t1.train_step(s1, batch)
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    assert mgr.save(2, s1)
+    mgr.wait()
+
+    t2 = new_trainer()
+    s2 = t2.init(params)
+    step, s2 = mgr.restore(s2)
+    assert step == 2
+    resumed = []
+    for _ in range(2):
+        s2, loss = t2.train_step(s2, batch)
+        resumed.append(float(loss))
+    np.testing.assert_allclose(resumed, ref[2:], rtol=1e-6)
+    mgr.close()

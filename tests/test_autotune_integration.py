@@ -83,3 +83,35 @@ def test_trainer_autotune_round_trip(autotune_env):
     # load, and each distinct signature gets its own compiled step
     assert len(signatures) > 1, "autotune never re-bucketed"
     assert len(trainer._step_cache) >= len(signatures)
+
+
+def test_algorithm_switch_restores_user_instance():
+    """A family switch away and back must restore the USER's configured
+    instance (comm_dtype etc.), not a default-constructed one."""
+    from bagua_tpu.define import BaguaHyperparameter
+
+    model = MLP(features=(16, 8))
+    mesh = build_mesh({"dp": N_DEVICES})
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+
+    user_algo = GradientAllReduceAlgorithm(comm_dtype=jnp.bfloat16)
+    trainer = BaguaTrainer(loss_fn, optax.sgd(0.1), user_algo, mesh=mesh,
+                           autotune=False)
+    trainer.init(params)
+
+    trainer._maybe_switch_algorithm(
+        BaguaHyperparameter(algorithm="bytegrad", is_hierarchical_reduce=False)
+    )
+    assert trainer.algorithm.name == "bytegrad"
+    trainer._maybe_switch_algorithm(
+        BaguaHyperparameter(algorithm="gradient_allreduce",
+                            is_hierarchical_reduce=False)
+    )
+    assert trainer.algorithm is user_algo
+    assert trainer.algorithm.comm_dtype == jnp.bfloat16

@@ -74,6 +74,35 @@ def test_matches_single_worker_sgd(hierarchical):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
 
 
+def test_bf16_comm_dtype_close_to_full_precision():
+    """comm_dtype=bfloat16 halves wire bytes; the result must track the
+    full-precision allreduce within bf16 rounding (bf16 keeps f32's
+    exponent range, so no scale factor is involved)."""
+    import jax.numpy as jnp
+
+    model = MLP(features=(16, NCLASS))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
+    loss_fn = _loss_fn(model)
+    xs, ys = _data(steps=3, seed=5)
+
+    outs = {}
+    for dtype in (None, jnp.bfloat16):
+        trainer = BaguaTrainer(
+            loss_fn, optax.sgd(0.1),
+            GradientAllReduceAlgorithm(comm_dtype=dtype), bucket_bytes=256,
+        )
+        st = trainer.init(params)
+        for s in range(xs.shape[0]):
+            st, _ = trainer.train_step(st, {"x": xs[s], "y": ys[s]})
+        outs[dtype] = st.params
+
+    for a, b in zip(jax.tree.leaves(outs[jnp.bfloat16]), jax.tree.leaves(outs[None])):
+        a, b = np.asarray(a), np.asarray(b)
+        # bf16 has ~3 decimal digits; after 3 SGD steps the drift stays
+        # within a few bf16 ulps of the weight scale
+        np.testing.assert_allclose(a, b, rtol=0, atol=3e-2)
+
+
 def test_sum_vs_avg_scales_update():
     model = MLP(features=(8, NCLASS))
     params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, DIM)))["params"]

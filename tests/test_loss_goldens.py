@@ -23,6 +23,7 @@ GOLDENS = {
     "qadam": 1.180702,
     "decentralized": 0.824863,
     "low_precision_decentralized": 0.764226,
+    "zero": 0.210334,
 }
 ASYNC_BOUND = 1.0  # async final loss is timing-dependent; must still converge
 

@@ -129,11 +129,20 @@ def test_rejects_model_parallel_axes():
     from bagua_tpu.parallel.mesh import build_mesh
 
     model = MLP(features=(8, NCLASS))
-    mesh = build_mesh({"dp": 4, "ep": 2})
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
+
     with pytest.raises(NotImplementedError):
         trainer = BaguaTrainer(
             _loss_fn(model), None, ZeroOptimizerAlgorithm(),
-            mesh=mesh, expert_axis="ep",
+            mesh=build_mesh({"dp": 4, "ep": 2}), expert_axis="ep",
         )
-        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
+        trainer.init(params)
+
+    # tp/pp arm of the guard: sharded_opt_state + a model-parallel shard axis
+    with pytest.raises(NotImplementedError):
+        trainer = BaguaTrainer(
+            _loss_fn(model), None, ZeroOptimizerAlgorithm(),
+            mesh=build_mesh({"dp": 4, "tp": 2}), tp_axis="tp",
+            tp_param_dim=lambda name: None,
+        )
         trainer.init(params)
