@@ -46,15 +46,12 @@ class GradientAllReduceAlgorithm(Algorithm):
     def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
         op = ReduceOp.AVG if self.average else ReduceOp.SUM
         flats = ctx.plan.flatten_tree(grads)
+        orig_dtypes = [f.dtype for f in flats]
         if self.comm_dtype is not None:
-            orig_dtypes = [f.dtype for f in flats]
             flats = [f.astype(self.comm_dtype) for f in flats]
-            flats = [
-                ctx.hierarchical_allreduce(f, op, self.hierarchical) for f in flats
-            ]
+        flats = [
+            ctx.hierarchical_allreduce(f, op, self.hierarchical) for f in flats
+        ]
+        if self.comm_dtype is not None:
             flats = [f.astype(d) for f, d in zip(flats, orig_dtypes)]
-        else:
-            flats = [
-                ctx.hierarchical_allreduce(f, op, self.hierarchical) for f in flats
-            ]
         return ctx.plan.unflatten_tree(flats, grads), algo_state

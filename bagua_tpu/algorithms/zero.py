@@ -89,12 +89,42 @@ class ZeroOptimizerAlgorithm(Algorithm):
         return jax.lax.dynamic_slice(flat, (start,), (size,))
 
     # ---- optimizer contract ---------------------------------------------
+    #
+    # State protocol (shared with the trainer): ``{"buckets": (optax state
+    # per bucket chunk, ...), "local": optax state over the name->array dict
+    # of NON-plan leaves}``.  "local" covers tp/pp-sharded leaves (3-D
+    # parallelism): each shard owns its slice outright and its gradient
+    # arrives already dp-averaged from the trainer, so a shard-local
+    # elementwise update is exact — no collective, state sharded like the
+    # leaf.  With no model-parallel axes "local" is an empty dict's state.
+
+    def _local_named(self, ctx: AlgorithmContext, tree):
+        from ..tensor import leaves_by_name
+
+        plan_names = set(ctx.plan.tensor_names)
+        return {
+            name: leaf for name, leaf in leaves_by_name(tree).items()
+            if name not in plan_names
+        }
 
     def init_optimizer_state_sharded(self, ctx: AlgorithmContext, params):
-        """Per-rank optimizer state: one optax state per bucket, built for
-        that rank's flat chunk only (runs inside ``shard_map``)."""
+        """Per-rank optimizer state (runs inside ``shard_map``): one optax
+        state per bucket built for that rank's flat chunk, plus the local
+        state for non-plan (model-parallel) leaves."""
         flats = ctx.plan.flatten_tree(params)
-        return tuple(self.optimizer.init(self._my_chunk(ctx, f)) for f in flats)
+        return {
+            "buckets": tuple(
+                self.optimizer.init(self._my_chunk(ctx, f)) for f in flats
+            ),
+            "local": self.init_optimizer_state_local(
+                self._local_named(ctx, params)
+            ),
+        }
+
+    def init_optimizer_state_local(self, local_named: dict):
+        """Axis-free init for the non-plan (tp/pp-sharded) leaves — also
+        used by the trainer via ``eval_shape`` to derive sharding specs."""
+        return self.optimizer.init(local_named)
 
     def init_optimizer_state(self, params):  # pragma: no cover - guard
         raise NotImplementedError(
@@ -108,10 +138,20 @@ class ZeroOptimizerAlgorithm(Algorithm):
         pflats = ctx.plan.flatten_tree(params)
         # grad averaging and sharding in one collective per bucket
         gchunks = [ctx.comm.reduce_scatter(gf, ReduceOp.AVG) for gf in gflats]
+        local_g = self._local_named(ctx, grads)
 
         if self.clip_global_norm is not None:
             # ||avg grad||² = psum of each rank's chunk contributions
-            # (bucket padding is zeros and does not perturb the norm)
+            # (bucket padding is zeros and does not perturb the norm).
+            # Local (model-parallel) leaves are excluded: their slices live
+            # on tp/pp axes outside this communicator, so a correct global
+            # norm would need a second psum over those axes — ZeRO with
+            # clipping is supported for pure-dp/sp meshes only.
+            if local_g:
+                raise NotImplementedError(
+                    "clip_global_norm with model-parallel (tp/pp) leaves "
+                    "is not supported"
+                )
             ssq = sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gchunks
             )
@@ -120,12 +160,25 @@ class ZeroOptimizerAlgorithm(Algorithm):
             gchunks = [(g * scale.astype(g.dtype)) for g in gchunks]
 
         new_pflats, new_states = [], []
-        for gchunk, pf, st in zip(gchunks, pflats, opt_state):
+        for gchunk, pf, st in zip(gchunks, pflats, opt_state["buckets"]):
             pchunk = self._my_chunk(ctx, pf)
             updates, st = self.optimizer.update(gchunk, st, pchunk)
             pchunk = optax.apply_updates(pchunk, updates)
             # re-replicate the updated params (rank chunks in rank order)
             new_pflats.append(ctx.comm.allgather(pchunk, tiled=True))
             new_states.append(st)
-        new_params = ctx.plan.unflatten_tree(new_pflats, params)
-        return new_params, tuple(new_states), algo_state
+        named = ctx.plan.unflatten_to_named(new_pflats)
+
+        local_state = opt_state["local"]
+        if local_g:
+            local_p = self._local_named(ctx, params)
+            updates, local_state = self.optimizer.update(
+                local_g, local_state, local_p
+            )
+            named.update(optax.apply_updates(local_p, updates))
+
+        from ..tensor import tree_from_named
+
+        new_params = tree_from_named(params, named)
+        return new_params, {"buckets": tuple(new_states),
+                            "local": local_state}, algo_state

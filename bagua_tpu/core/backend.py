@@ -396,12 +396,9 @@ class BaguaTrainer:
         else:
             opt_init = self.optimizer.init
 
-        if algo.sharded_opt_state and (
-            self.expert_axis is not None or self._shard_axis is not None
-        ):
+        if algo.sharded_opt_state and self.expert_axis is not None:
             raise NotImplementedError(
-                "sharded_opt_state with expert/tensor/pipeline parallelism "
-                "is not supported yet"
+                "sharded_opt_state with expert parallelism is not supported yet"
             )
 
         if self.expert_axis is not None:
@@ -433,20 +430,51 @@ class BaguaTrainer:
             )
 
         if algo.replicated_params and algo.sharded_opt_state:
-            # ZeRO-1 layout: params replicated, optimizer state sharded over
-            # the comm axes.  The stacked leading axis makes each rank's
-            # chunk-state addressable with the same spec machinery as the
-            # gossip algorithms' per-rank state.
+            # ZeRO-1 layout: dense params replicated, their optimizer state
+            # sharded over the comm axes (stacked leading axis — the same
+            # spec machinery as the gossip algorithms' per-rank state).
+            # With tp/pp, the "local" state part mirrors the sharded leaves'
+            # own placements (state protocol: {"buckets", "local"}).
+            in_spec = P()
+            local_spec = P()
+            if self._shard_axis is not None:
+                self._param_specs = self._tp_param_spec_tree(params)
+                sharded = {}
+                flat = jax.tree_util.tree_flatten_with_path(self._param_specs)[0]
+                for path, spec in flat:
+                    if spec != P():
+                        sharded[_name_of_path(path)] = spec
+                in_spec = self._param_specs
+                # axis-free eval_shape on LOCAL slice shapes gives the local
+                # state's structure; specs then follow the matching leaf
+                local_template = {}
+                for p in build_params(params):
+                    entries = self._shard_entries(p.name)
+                    if entries:
+                        shape = list(p.shape)
+                        for d, ax in entries:
+                            shape[d] //= mesh.shape[ax]
+                        local_template[p.name] = jax.ShapeDtypeStruct(
+                            tuple(shape), p.dtype
+                        )
+                local_struct = jax.eval_shape(
+                    algo.init_optimizer_state_local, local_template
+                )
+                local_spec = self._tp_match_spec_tree(local_struct, sharded)
+            self._zero_opt_specs = {"buckets": P(self.comm_axes),
+                                    "local": local_spec}
+
             def init_fn(p):
                 a = algo.init_state(ctx, p)
                 o = algo.init_optimizer_state_sharded(ctx, p)
                 stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
-                return stack(o), stack(a)
+                return {"buckets": stack(o["buckets"]),
+                        "local": o["local"]}, stack(a)
 
-            ospec = P(self.comm_axes)
             opt_state, algo_state = jax.jit(
-                shard_map(init_fn, mesh=mesh, in_specs=(P(),),
-                          out_specs=(ospec, ospec), check_vma=False)
+                shard_map(init_fn, mesh=mesh, in_specs=(in_spec,),
+                          out_specs=(self._zero_opt_specs, P(self.comm_axes)),
+                          check_vma=False)
             )(params)
             return TrainState(jnp.zeros((), jnp.int32), params, opt_state, algo_state)
 
@@ -525,7 +553,9 @@ class BaguaTrainer:
                     _unstack(params), _unstack(opt_state), _unstack(algo_state)
                 )
             elif opt_stacked:
-                opt_state, algo_state = _unstack(opt_state), _unstack(algo_state)
+                opt_state = {"buckets": _unstack(opt_state["buckets"]),
+                             "local": opt_state["local"]}
+                algo_state = _unstack(algo_state)
             step = state.step
 
             if self.accum_steps > 1:
@@ -619,22 +649,28 @@ class BaguaTrainer:
                     _stack(params), _stack(opt_state), _stack(algo_state)
                 )
             elif opt_stacked:
-                opt_state, algo_state = _stack(opt_state), _stack(algo_state)
+                opt_state = {"buckets": _stack(opt_state["buckets"]),
+                             "local": opt_state["local"]}
+                algo_state = _stack(algo_state)
             return TrainState(state.step + 1, params, opt_state, algo_state), loss
 
         if expert is not None:
             pspec = P((expert,))
             state_specs = TrainState(step=P(), params=pspec, opt_state=pspec,
                                      algo_state=pspec)
+        elif opt_stacked:
+            # ZeRO-1: bucket chunk states stacked over the comm axes; with
+            # tp/pp, params and the "local" state part carry the model-
+            # parallel placements
+            pspec = self._param_specs if self._shard_axis is not None else P()
+            state_specs = TrainState(step=P(), params=pspec,
+                                     opt_state=self._zero_opt_specs,
+                                     algo_state=P(self.comm_axes))
         elif self._shard_axis is not None:
             state_specs = TrainState(
                 step=P(), params=self._param_specs,
                 opt_state=self._opt_specs, algo_state=P(),
             )
-        elif opt_stacked:
-            sspec = P(self.comm_axes)
-            state_specs = TrainState(step=P(), params=P(), opt_state=sspec,
-                                     algo_state=sspec)
         else:
             pspec = P() if replicated else P(dp)
             state_specs = TrainState(step=P(), params=pspec, opt_state=pspec,

@@ -145,6 +145,26 @@ def main():
         mesh=build_mesh({"dp": 2, "ep": 4}), expert_axis="ep",
         autotune=False), moe_params, tokens)
 
+    # --- 3-D: dp x pp x tp in one step -----------------------------------
+    m3_cfg = _cfg(tp_axis="tp", tp_size=2)
+    m3 = PipelinedTransformerLM(m3_cfg, pp_size=2, n_microbatches=2)
+    m3_params = globalize_pp_params(
+        m3.init(jax.random.PRNGKey(9), tokens[:2])["params"],
+        jax.random.PRNGKey(10), 2, tp_size=2)
+    run("dp=2 x pp=2 x tp=2 (3-D)", bagua_tpu.BaguaTrainer(
+        pp_lm_loss_fn(m3), optax.adam(1e-2), GradientAllReduceAlgorithm(),
+        mesh=build_mesh({"dp": 2, "pp": 2, "tp": 2}), pp_axis="pp",
+        tp_axis="tp", autotune=False), m3_params, tokens)
+
+    # --- ZeRO-1 (sharded optimizer state) + grad accumulation ------------
+    from bagua_tpu.algorithms.zero import ZeroOptimizerAlgorithm
+
+    run("dp=8 ZeRO-1 + accum=2", bagua_tpu.BaguaTrainer(
+        lm_loss_fn(model), None,
+        ZeroOptimizerAlgorithm(optax.adam(1e-2), clip_global_norm=1.0),
+        mesh=build_mesh({"dp": 8}), accum_steps=2, autotune=False),
+        params, tokens)
+
     print("all parallelism axes ran")
 
 

@@ -82,14 +82,15 @@ def test_optimizer_state_is_sharded():
     total_padded = sum(b.padded_numel for b in trainer._plan.buckets)
     # adam: exp_avg (mu) + exp_avg_sq (nu) per bucket chunk; the stacked
     # global view is [N, chunk] so each rank materializes chunk = padded/N
-    for bucket_state in state.opt_state:
+    buckets = state.opt_state["buckets"]
+    for bucket_state in buckets:
         adam_state = bucket_state[0]  # ScaleByAdamState
         assert adam_state.mu.ndim == 2  # [N, chunk] stacked global view
-    chunk_elems = sum(bs[0].mu.shape[1] for bs in state.opt_state)
+    chunk_elems = sum(bs[0].mu.shape[1] for bs in buckets)
     assert chunk_elems == total_padded // N
 
     # each per-rank shard holds only its chunk
-    for bs in state.opt_state:
+    for bs in buckets:
         shard_shapes = {s.data.shape for s in bs[0].mu.addressable_shards}
         assert all(s[0] == 1 for s in shard_shapes)
 
@@ -125,7 +126,7 @@ def test_clip_global_norm_matches_optax():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
 
 
-def test_rejects_model_parallel_axes():
+def test_rejects_expert_axis():
     from bagua_tpu.parallel.mesh import build_mesh
 
     model = MLP(features=(8, NCLASS))
@@ -138,11 +139,131 @@ def test_rejects_model_parallel_axes():
         )
         trainer.init(params)
 
-    # tp/pp arm of the guard: sharded_opt_state + a model-parallel shard axis
-    with pytest.raises(NotImplementedError):
-        trainer = BaguaTrainer(
-            _loss_fn(model), None, ZeroOptimizerAlgorithm(),
-            mesh=build_mesh({"dp": 4, "tp": 2}), tp_axis="tp",
-            tp_param_dim=lambda name: None,
+
+def test_zero_with_tp_matches_replicated_adam():
+    """ZeRO composed with tensor parallelism (dp=4 x tp=2): dense buckets
+    take the reduce_scatter/all_gather path over dp, tp slices get the
+    shard-local update with leaf-sharded state — must equal plain DP+TP
+    adam elementwise."""
+    from bagua_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss_fn, tp_param_dim,
+    )
+    from bagua_tpu.parallel.mesh import build_mesh
+    from bagua_tpu.parallel.tensor_parallel import globalize_tp_params
+
+    TPd = 2
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                            d_ff=64, max_seq_len=8, dtype=jnp.float32,
+                            tp_axis="tp", tp_size=TPd)
+    model = TransformerLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 9), 0, 64)
+    params = globalize_tp_params(
+        model.init(jax.random.PRNGKey(1), tokens[:2, :-1])["params"],
+        jax.random.PRNGKey(2), TPd, tp_param_dim,
+    )
+    mesh = build_mesh({"dp": 4, "tp": TPd})
+
+    def train(trainer):
+        st = trainer.init(params)
+        batch = trainer.shard_batch({"tokens": tokens})
+        for _ in range(4):
+            st, loss = trainer.train_step(st, batch)
+        return st, float(loss)
+
+    st_zero, loss_zero = train(BaguaTrainer(
+        lm_loss_fn(model), None, ZeroOptimizerAlgorithm(optax.adam(1e-2)),
+        mesh=mesh, tp_axis="tp", autotune=False,
+    ))
+    st_plain, loss_plain = train(BaguaTrainer(
+        lm_loss_fn(model), optax.adam(1e-2), GradientAllReduceAlgorithm(),
+        mesh=mesh, tp_axis="tp", autotune=False,
+    ))
+
+    np.testing.assert_allclose(loss_zero, loss_plain, atol=1e-5)
+    flat_z = jax.tree_util.tree_leaves_with_path(st_zero.params)
+    flat_p = dict(jax.tree_util.tree_leaves_with_path(st_plain.params))
+    for path, leaf in flat_z:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_p[path]), rtol=2e-5, atol=2e-5,
+            err_msg=jax.tree_util.keystr(path),
         )
-        trainer.init(params)
+
+
+def test_zero_with_3d_matches_replicated_adam():
+    """ZeRO under the full dp x pp x tp mesh must equal the plain
+    GradientAllReduce + adam trainer elementwise — guarding the ZeRO
+    interaction with the pp prescale (dense buckets reduce-scatter over
+    dp + pp AFTER the prescale turns the average into the cross-stage
+    sum)."""
+    from bagua_tpu.models.transformer import TransformerConfig
+    from bagua_tpu.parallel.mesh import build_mesh
+    from bagua_tpu.parallel.pipeline import (
+        PipelinedTransformerLM, globalize_pp_params, pp_lm_loss_fn,
+    )
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=4,
+                            d_ff=64, max_seq_len=8, dtype=jnp.float32,
+                            tp_axis="tp", tp_size=2)
+    model = PipelinedTransformerLM(cfg, pp_size=2, n_microbatches=2)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (8, 9), 0, 64)
+    params = globalize_pp_params(
+        model.init(jax.random.PRNGKey(5), tokens[:2])["params"],
+        jax.random.PRNGKey(6), 2, tp_size=2,
+    )
+    mesh = build_mesh({"dp": 2, "pp": 2, "tp": 2})
+
+    def train(trainer):
+        st = trainer.init(params)
+        batch = trainer.shard_batch({"tokens": tokens})
+        losses = []
+        for _ in range(6):
+            st, loss = trainer.train_step(st, batch)
+            losses.append(float(loss))
+        return st, losses
+
+    st_zero, l_zero = train(BaguaTrainer(
+        pp_lm_loss_fn(model), None, ZeroOptimizerAlgorithm(optax.adam(1e-2)),
+        mesh=mesh, pp_axis="pp", tp_axis="tp", autotune=False,
+    ))
+    st_plain, l_plain = train(BaguaTrainer(
+        pp_lm_loss_fn(model), optax.adam(1e-2), GradientAllReduceAlgorithm(),
+        mesh=mesh, pp_axis="pp", tp_axis="tp", autotune=False,
+    ))
+
+    assert l_zero[-1] < l_zero[0], l_zero
+    np.testing.assert_allclose(l_zero, l_plain, rtol=1e-5, atol=1e-6)
+    flat_z = jax.tree_util.tree_leaves_with_path(st_zero.params)
+    flat_p = dict(jax.tree_util.tree_leaves_with_path(st_plain.params))
+    for path, leaf in flat_z:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_p[path]), rtol=2e-5, atol=2e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_zero_clip_rejects_model_parallel():
+    """clip_global_norm only sees the dp-sharded chunks, so combining it
+    with tp/pp leaves must fail loudly, not silently misclip."""
+    from bagua_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss_fn, tp_param_dim,
+    )
+    from bagua_tpu.parallel.mesh import build_mesh
+    from bagua_tpu.parallel.tensor_parallel import globalize_tp_params
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, max_seq_len=8, dtype=jnp.float32,
+                            tp_axis="tp", tp_size=2)
+    model = TransformerLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 9), 0, 64)
+    params = globalize_tp_params(
+        model.init(jax.random.PRNGKey(1), tokens[:2, :-1])["params"],
+        jax.random.PRNGKey(2), 2, tp_param_dim,
+    )
+    trainer = BaguaTrainer(
+        lm_loss_fn(model), None,
+        ZeroOptimizerAlgorithm(optax.adam(1e-2), clip_global_norm=1.0),
+        mesh=build_mesh({"dp": 4, "tp": 2}), tp_axis="tp", autotune=False,
+    )
+    state = trainer.init(params)
+    with pytest.raises(NotImplementedError, match="clip_global_norm"):
+        trainer.train_step(state, trainer.shard_batch({"tokens": tokens}))
