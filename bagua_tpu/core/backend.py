@@ -354,6 +354,16 @@ class BaguaTrainer:
 
         return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
+    def _sharded_specs_by_name(self) -> Dict[str, P]:
+        """name -> PartitionSpec for every model-parallel (non-replicated)
+        param leaf; requires ``self._param_specs``."""
+        sharded = {}
+        flat = jax.tree_util.tree_flatten_with_path(self._param_specs)[0]
+        for path, spec in flat:
+            if spec != P():
+                sharded[_name_of_path(path)] = spec
+        return sharded
+
     def _tp_match_spec_tree(self, tree, sharded_by_name):
         """Specs for a param-mirroring tree (optimizer state): a leaf whose
         dotted path ends with a tp param's full name inherits its spec."""
@@ -439,11 +449,7 @@ class BaguaTrainer:
             local_spec = P()
             if self._shard_axis is not None:
                 self._param_specs = self._tp_param_spec_tree(params)
-                sharded = {}
-                flat = jax.tree_util.tree_flatten_with_path(self._param_specs)[0]
-                for path, spec in flat:
-                    if spec != P():
-                        sharded[_name_of_path(path)] = spec
+                sharded = self._sharded_specs_by_name()
                 in_spec = self._param_specs
                 # axis-free eval_shape on LOCAL slice shapes gives the local
                 # state's structure; specs then follow the matching leaf
@@ -498,12 +504,9 @@ class BaguaTrainer:
                         "carry init_state trees is not supported yet"
                     )
                 self._param_specs = self._tp_param_spec_tree(params)
-                sharded = {}
-                flat = jax.tree_util.tree_flatten_with_path(self._param_specs)[0]
-                for path, spec in flat:
-                    if spec != P():
-                        sharded[_name_of_path(path)] = spec
-                self._opt_specs = self._tp_match_spec_tree(opt_state, sharded)
+                self._opt_specs = self._tp_match_spec_tree(
+                    opt_state, self._sharded_specs_by_name()
+                )
             return TrainState(jnp.zeros((), jnp.int32), params, opt_state, algo_state)
 
         # per-rank (gossip) state: stack every leaf along a leading rank axis
@@ -676,6 +679,7 @@ class BaguaTrainer:
             state_specs = TrainState(step=P(), params=pspec, opt_state=pspec,
                                      algo_state=pspec)
         batch_spec = self._batch_spec()
+        self._state_specs = state_specs  # reused by eval_step
 
         fn = shard_map(
             per_shard,
@@ -734,6 +738,52 @@ class BaguaTrainer:
                 device_fence(out[1])
             return out
         return fn(state, batch)
+
+    def _make_eval_fn(self, state_specs, batch_spec):
+        algo = self.algorithm
+        expert = self.expert_axis
+        stacked = (not algo.replicated_params) or expert is not None
+
+        def per_shard(state: TrainState, batch):
+            params = state.params
+            if stacked:
+                params = jax.tree.map(lambda x: x[0], params)
+            rows = jax.tree.leaves(batch)[0].shape[0]
+            accum = self.accum_steps if rows % self.accum_steps == 0 else 1
+            if accum > 1:
+                # keep eval's working set at the train step's microbatch
+                # size — accum_steps exists because the full batch doesn't
+                # fit; mean of equal-size microbatch means == full mean
+                microbatches = jax.tree.map(
+                    lambda x: x.reshape((accum, x.shape[0] // accum)
+                                        + x.shape[1:]),
+                    batch,
+                )
+                loss = jnp.mean(jax.lax.map(
+                    lambda mb: self.loss_fn(params, mb), microbatches
+                ))
+            else:
+                loss = self.loss_fn(params, batch)
+            return self._comm.allreduce(loss, ReduceOp.AVG)
+
+        fn = shard_map(per_shard, mesh=self.mesh,
+                       in_specs=(state_specs, batch_spec), out_specs=P(),
+                       check_vma=False)
+        return jax.jit(fn)
+
+    def eval_step(self, state: TrainState, batch) -> jax.Array:
+        """Forward-only mean loss over the global batch — same sharding as
+        ``train_step`` (state untouched, nothing donated).  Evaluation has
+        no reference counterpart hook (the reference evaluates on the raw
+        torch module); here the jitted step owns the sharded params, so the
+        trainer provides the entry point."""
+        if not hasattr(self, "_eval_fn"):
+            # reuse the train step's state layout: build (or fetch) the
+            # compiled step first, then lift its specs
+            self._get_step_fn()
+            self._eval_fn = self._make_eval_fn(self._state_specs,
+                                               self._batch_spec())
+        return self._eval_fn(state, batch)
 
     def _report_tensor_execution_order(self, state, batch) -> None:
         """Feed the sidecar the observed gradient-readiness order (the
@@ -899,9 +949,32 @@ class BaguaTrainer:
         from ..parallel.mesh import make_global_array
 
         spec = self._batch_spec()
-        return jax.tree.map(
-            lambda x: make_global_array(self.mesh, spec, x), local_batch
-        )
+        shards = 1
+        for ax_entry in spec:
+            for ax in (ax_entry if isinstance(ax_entry, tuple) else (ax_entry,)):
+                if ax is not None:
+                    shards *= self.mesh.shape[ax]
+            break  # only the leading (batch) dim is sharded
+
+        def check_and_make(x):
+            # single-process only: with multiple processes each feeds its
+            # own slice, so the per-process row count is a fraction of the
+            # global requirement.  Only the shard count is enforced here —
+            # accum_steps divisibility is a train-path concern (eval_step
+            # consumes any shardable batch) and the step raises its own
+            # clear error
+            rows = (
+                jnp.shape(x)[0]
+                if jnp.ndim(x) and jax.process_count() == 1 else None
+            )
+            if rows is not None and rows % shards:
+                raise ValueError(
+                    f"batch leading dim {rows} must be divisible by "
+                    f"{shards} (the number of batch shards)"
+                )
+            return make_global_array(self.mesh, spec, x)
+
+        return jax.tree.map(check_and_make, local_batch)
 
     def unstack_params(self, state: TrainState):
         """Return params in user shape (for eval/checkpoint): rank 0's copy
