@@ -783,6 +783,13 @@ class BaguaTrainer:
             self._get_step_fn()
             self._eval_fn = self._make_eval_fn(self._state_specs,
                                                self._batch_spec())
+        if self._watchdog is not None:
+            # same hang-surfacing contract as train_step: a wedged eval
+            # allreduce must trip the watchdog, not hang silently
+            with self._watchdog.watch("eval_step"):
+                loss = self._eval_fn(state, batch)
+                device_fence(loss)
+            return loss
         return self._eval_fn(state, batch)
 
     def _report_tensor_execution_order(self, state, batch) -> None:
