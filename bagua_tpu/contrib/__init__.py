@@ -12,6 +12,7 @@ from .load_balancing_data_loader import (  # noqa: F401
     LoadBalancingDistributedBatchSampler,
     LoadBalancingDistributedSampler,
 )
+from .prefetch import prefetch_to_device  # noqa: F401
 from .sync_batchnorm import SyncBatchNorm  # noqa: F401
 
 __all__ = [
@@ -22,4 +23,5 @@ __all__ = [
     "CacheLoader",
     "CachedDataset",
     "SyncBatchNorm",
+    "prefetch_to_device",
 ]

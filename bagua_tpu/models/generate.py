@@ -1,0 +1,113 @@
+"""Autoregressive generation with a KV cache (inference path).
+
+Additive — the reference is a training accelerator with no serving story;
+a complete LM framework needs one.  TPU-idiomatic formulation: the whole
+generation loop is ONE jitted program — a ``lax.scan`` over decode steps,
+each consuming one token against the flax ``"cache"`` collection that
+:class:`~bagua_tpu.models.transformer.TransformerLM` maintains in decode
+mode (``TransformerConfig(decode=True)``).  Static shapes throughout: the
+cache is pre-allocated at ``max_seq_len`` and the scan length is
+``prompt_len + max_new_tokens - 1``, so one compile serves a fixed
+(batch, prompt_len, max_new) signature.
+
+Sampling: greedy at ``temperature=0`` (exact continuation of the argmax
+chain), else temperature-scaled categorical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["decode_model", "generate"]
+
+
+def decode_model(model):
+    """The decode-mode twin of a ``TransformerLM`` (same params, KV-cached
+    single-token attention; ``attn_fn`` is unused in decode)."""
+    cfg = dataclasses.replace(model.cfg, decode=True)
+    return type(model)(cfg, attn_fn=None, mlp_factory=model.mlp_factory,
+                       head=model.head)
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature):
+    b, prompt_len = prompt.shape
+    cache = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((b, 1), jnp.int32)
+    )["cache"]
+
+    def step(carry, inputs):
+        cache, feed = carry  # feed: [b] token consumed this step
+        key, forced, forced_tok = inputs
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            feed[:, None], mutable=["cache"],
+        )
+        logits = logits[:, 0]  # [b, vocab]
+        sampled = jnp.where(
+            temperature > 0.0,
+            jax.random.categorical(key, logits / jnp.maximum(temperature, 1e-6)),
+            jnp.argmax(logits, axis=-1),
+        ).astype(prompt.dtype)
+        # while still inside the prompt, the "next token" is forced
+        nxt = jnp.where(forced, forced_tok, sampled)
+        return (mutated["cache"], nxt), nxt
+
+    n_steps = prompt_len + max_new_tokens - 1
+    keys = jax.random.split(rng, n_steps)
+    # step i feeds token i; for i < prompt_len - 1 the output is forced to
+    # prompt[i + 1] (teacher forcing through the prompt)
+    forced = jnp.arange(n_steps) < (prompt_len - 1)
+    forced_tok = jnp.concatenate(
+        [prompt[:, 1:], jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1
+    ).T  # [n_steps, b]
+    (_, _), toks = jax.lax.scan(
+        step, (cache, prompt[:, 0]), (keys, forced, forced_tok),
+    )
+    # toks[i] = token fed at step i+1; the generated continuation is the
+    # last max_new_tokens of them
+    return toks[prompt_len - 1:].T  # [b, max_new_tokens]
+
+
+def generate(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+):
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    Args:
+        model: a ``TransformerLM`` in decode mode (``decode_model(m)``), or
+            a training-mode model (converted automatically).
+        params: the trained params (training and decode modes share them).
+        prompt: int32 ``[batch, prompt_len]``, ``prompt_len >= 1``;
+            ``prompt_len + max_new_tokens`` must fit ``cfg.max_seq_len``.
+        temperature: 0 = greedy, else categorical at the given temperature.
+        rng: PRNG key (required only for temperature > 0).
+
+    Returns:
+        int32 ``[batch, max_new_tokens]``.
+    """
+    if not model.cfg.decode:
+        model = decode_model(model)
+    b, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_seq_len {model.cfg.max_seq_len}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_jit(model, params, prompt, int(max_new_tokens), rng,
+                         jnp.float32(temperature))

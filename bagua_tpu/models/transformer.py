@@ -47,6 +47,11 @@ class TransformerConfig:
     #: divisible by tp_size.
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    #: autoregressive decode mode: attention keeps a KV cache ("cache"
+    #: variable collection) and consumes one token per call.  Only valid
+    #: through models/generate.py — a decode=True config cannot train
+    #: (single-token attention, mutable cache).
+    decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -122,8 +127,11 @@ class Attention(nn.Module):
             param_dtype=cfg.param_dtype, use_bias=False,
         )
         q, k, v = dense("q")(x), dense("k")(x), dense("v")(x)
-        fn = self.attn_fn or causal_attention
-        o = fn(q, k, v, cfg.dtype)
+        if cfg.decode:
+            o = self._decode_attend(q, k, v)
+        else:
+            fn = self.attn_fn or causal_attention
+            o = fn(q, k, v, cfg.dtype)
         out = nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), name="o", dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, use_bias=False,
@@ -133,6 +141,47 @@ class Attention(nn.Module):
 
             out = tp_reduce(out, cfg.tp_axis)  # row-parallel partial sums
         return out
+
+    def _decode_attend(self, q, k, v):
+        """Single-token attention against a KV cache ("cache" collection;
+        flax's canonical decode pattern).  ``q/k/v`` are ``[b, 1, h, d]``;
+        new K/V land at ``cache_index`` and q attends to positions
+        ``<= cache_index``."""
+        cfg = self.cfg
+        b, qlen, h, d = q.shape
+        assert qlen == 1, f"decode consumes one token per call, got {qlen}"
+        # flax's canonical guard: the init pass also runs this code, and
+        # must NOT advance the cache it is creating
+        is_initialized = self.has_variable("cache", "cached_key")
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (b, cfg.max_seq_len, h, d), cfg.dtype,
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (b, cfg.max_seq_len, h, d), cfg.dtype,
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if not is_initialized:
+            return v  # init trace: single token attends only to itself
+        idx = cache_index.value
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+        )
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+        )
+        cache_index.value = idx + 1
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, cached_k.value,
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(d).astype(jnp.float32)
+        mask = jnp.arange(cfg.max_seq_len) <= idx  # [k]
+        scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, cached_v.value)
 
 
 class MLPBlock(nn.Module):
@@ -200,6 +249,16 @@ class TransformerLM(nn.Module):
         start = 0
         if cfg.sp_axis is not None and _axis_bound(cfg.sp_axis):
             start = jax.lax.axis_index(cfg.sp_axis) * s
+        if cfg.decode:
+            # autoregressive position counter (mirrors the attention cache;
+            # same init-pass guard — see Attention._decode_attend)
+            advance = self.has_variable("cache", "pos_index")
+            pos_index = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if advance:
+                start = pos_index.value
+                pos_index.value = start + s
         pos_slice = jax.lax.dynamic_slice_in_dim(pos, start, s, axis=0)
         x = x + pos_slice[None].astype(cfg.dtype)
         block_cls = nn.checkpoint(Block) if cfg.remat else Block

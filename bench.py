@@ -259,6 +259,39 @@ def bench_vgg16(mesh, n_dev: int) -> dict:
     }
 
 
+def bench_decode(mesh, n_dev: int) -> dict:
+    """KV-cache autoregressive decode throughput (tokens/s) on the
+    transformer LM — the inference path (additive; no reference
+    counterpart)."""
+    from bagua_tpu.models.generate import generate
+    from bagua_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=32768, d_model=512, n_heads=8,
+                            n_layers=4, d_ff=2048, max_seq_len=512)
+    model = TransformerLM(cfg)
+    batch, prompt_len, new = 8, 32, 256
+    prompt = jnp.zeros((batch, prompt_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    # same cold-trial amortization as _time_steps: each generate() is one
+    # dispatch of a 287-step scan, so a handful of calls suffices
+    warmup, timed = 2, 8
+    for _ in range(warmup):
+        out = generate(model, params, prompt, new)
+    float(out.sum())  # drain before the timer
+    t0 = time.perf_counter()
+    for _ in range(timed):
+        out = generate(model, params, prompt, new)
+    float(out.sum())  # readback fence
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "lm_decode_tokens_per_sec",
+        "value": round(timed * batch * new / dt, 1),
+        "unit": "tok/s",
+        "vs_baseline": None,
+    }
+
+
 def bench_longctx(mesh, n_dev: int) -> dict:
     """Long-context LM throughput — the flash-attention (Pallas) hot path.
     ``vs_baseline`` is the speedup over the same model with the plain
@@ -373,6 +406,7 @@ def main():
         ))
         records.append(_emit(bench_bert(mesh, n_dev)))
         records.append(_emit(bench_longctx(mesh, n_dev)))
+        records.append(_emit(bench_decode(mesh, n_dev)))
         with open("BENCH_SUITE.json", "w") as f:
             json.dump(records, f, indent=1)
         return
