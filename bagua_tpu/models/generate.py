@@ -23,7 +23,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["decode_model", "generate"]
+__all__ = ["decode_model", "generate", "generate_tp"]
 
 
 def decode_model(model):
@@ -34,8 +34,7 @@ def decode_model(model):
                        head=model.head)
 
 
-@partial(jax.jit, static_argnums=(0, 3))
-def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature):
+def _generate_core(model, params, prompt, max_new_tokens, rng, temperature):
     b, prompt_len = prompt.shape
     cache = model.init(
         jax.random.PRNGKey(0), jnp.zeros((b, 1), jnp.int32)
@@ -72,6 +71,10 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature):
     # toks[i] = token fed at step i+1; the generated continuation is the
     # last max_new_tokens of them
     return toks[prompt_len - 1:].T  # [b, max_new_tokens]
+
+
+_generate_jit = partial(jax.jit, static_argnums=(0, 3))(_generate_core)
+_TP_GEN_CACHE: dict = {}
 
 
 def generate(
@@ -111,3 +114,95 @@ def generate(
         rng = jax.random.PRNGKey(0)
     return _generate_jit(model, params, prompt, int(max_new_tokens), rng,
                          jnp.float32(temperature))
+
+
+def generate_tp(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    mesh,
+    tp_axis: str = "tp",
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    tp_param_dim=None,
+):
+    """Tensor-parallel generation: the decode loop runs under ``shard_map``
+    over ``tp_axis``, with attention heads / FFN width sharded exactly as in
+    training (the model's conjugate collectives reduce the per-shard
+    partials, so logits — and therefore samples — are identical on every
+    shard).  ``params`` are the GLOBAL arrays (as held by a
+    ``BaguaTrainer(tp_axis=...)`` state); ``tp_param_dim`` maps param name →
+    sharded dim (default: the transformer family's table).
+
+    ``mesh`` may carry extra (replication) axes besides ``tp_axis`` — on
+    the CPU-simulation platform prefer a mesh spanning ALL devices (e.g.
+    ``build_mesh({"rep": 4, "tp": 2})``): XLA's in-process communicator can
+    wedge on collectives over a device subset after full-device work ran
+    in the same process.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..tensor import _name_of_path
+
+    if not model.cfg.decode:
+        model = decode_model(model)
+    if model.cfg.tp_axis != tp_axis or model.cfg.tp_size <= 1:
+        raise ValueError(
+            f"model config must carry tp_axis={tp_axis!r} with tp_size > 1 "
+            f"(got tp_axis={model.cfg.tp_axis!r}, tp_size={model.cfg.tp_size})"
+        )
+    b, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_seq_len {model.cfg.max_seq_len}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if mesh.shape[tp_axis] != model.cfg.tp_size:
+        raise ValueError(
+            f"mesh axis {tp_axis!r} has size {mesh.shape[tp_axis]} but the "
+            f"model config says tp_size={model.cfg.tp_size}"
+        )
+    if tp_param_dim is None:
+        from .transformer import tp_param_dim as _default_dim
+
+        tp_param_dim = _default_dim
+
+    def leaf_spec(path, leaf):
+        d = tp_param_dim(_name_of_path(path))
+        return P() if d is None else P(*([None] * d + [tp_axis]))
+
+    pspecs = jax.tree_util.tree_map_with_path(leaf_spec, params)
+    # params may live on a different (e.g. training dp) mesh — lay them out
+    # on THIS mesh with their tp shardings before entering the jit
+    from jax.sharding import NamedSharding
+
+    params = jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params, pspecs,
+    )
+    replicated = NamedSharding(mesh, P())
+    prompt = jax.device_put(prompt, replicated)
+    rng = jax.device_put(rng, replicated)
+    n = int(max_new_tokens)
+
+    # one compiled fn per (model, mesh, axis, budget, param structure) —
+    # rebuilding jit(shard_map(...)) per call would re-trace the whole
+    # decode scan every request (the _EAGER_CACHE lesson, communication.py)
+    cache_key = (model, mesh, tp_axis, n, jax.tree_util.tree_structure(pspecs))
+    fn = _TP_GEN_CACHE.get(cache_key)
+    if fn is None:
+        def per_shard(p, toks, key, temp):
+            return _generate_core(model, p, toks, n, key, temp)
+
+        fn = jax.jit(shard_map(
+            per_shard, mesh=mesh, in_specs=(pspecs, P(), P(), P()),
+            out_specs=P(), check_vma=False,
+        ))
+        _TP_GEN_CACHE[cache_key] = fn
+    return fn(params, prompt, rng, jnp.float32(temperature))

@@ -72,3 +72,8 @@ def test_imagenet_resnet_example_tiny():
 def test_parallelism_zoo_example():
     out = _run_example("parallelism_zoo.py", timeout=900)
     assert "all parallelism axes ran" in out
+
+
+def test_generate_lm_example():
+    out = _run_example("generate_lm.py")
+    assert "generate_lm OK" in out
