@@ -311,7 +311,12 @@ class BaguaTrainer:
         stacking at its reported dim, tp slicing at the tp dim.  When a leaf
         is both pp-stacked and tp-sharded (3-D parallelism), the tp dim —
         reported by ``tp_param_dim`` in per-layer coordinates — shifts one
-        right past the leading stage dim."""
+        right past the leading stage dim.
+
+        Under a sharded-opt-state (ZeRO) algorithm, expert leaves are also
+        expressed this way — global ``[n_experts, ...]`` sharded at dim 0
+        over the expert axis — instead of the stacked per-rank layout the
+        other algorithm families use."""
         entries = []
         if self.pp_axis is not None and self._pp_param_dim is not None:
             d = self._pp_param_dim(name)
@@ -322,6 +327,12 @@ class BaguaTrainer:
             if d is not None:
                 shift = 1 if entries else 0
                 entries.append((d + shift, self.tp_axis))
+        if (
+            self.expert_axis is not None
+            and self.algorithm.sharded_opt_state
+            and self._expert_filter(name)
+        ):
+            entries.append((0, self.expert_axis))
         return tuple(entries)
 
     def _is_sharded(self, name: str) -> bool:
@@ -406,12 +417,7 @@ class BaguaTrainer:
         else:
             opt_init = self.optimizer.init
 
-        if algo.sharded_opt_state and self.expert_axis is not None:
-            raise NotImplementedError(
-                "sharded_opt_state with expert parallelism is not supported yet"
-            )
-
-        if self.expert_axis is not None:
+        if self.expert_axis is not None and not algo.sharded_opt_state:
             # everything is stacked per ep-rank (leading axis sharded over
             # 'ep'): expert leaves enter as global [n_experts, ...] and are
             # split; dense leaves are replicated copies kept in lockstep by
@@ -447,7 +453,7 @@ class BaguaTrainer:
             # own placements (state protocol: {"buckets", "local"}).
             in_spec = P()
             local_spec = P()
-            if self._shard_axis is not None:
+            if self._shard_axis is not None or self.expert_axis is not None:
                 self._param_specs = self._tp_param_spec_tree(params)
                 sharded = self._sharded_specs_by_name()
                 in_spec = self._param_specs
@@ -533,11 +539,14 @@ class BaguaTrainer:
         replicated = algo.replicated_params
         expert = self.expert_axis
         # per-shard state is stacked (leading rank axis) for gossip
-        # algorithms and for expert parallelism
-        stacked = (not replicated) or expert is not None
+        # algorithms and for expert parallelism — except under ZeRO, whose
+        # layout expresses expert leaves as dim-0-sharded global arrays
+        stacked = (
+            (not replicated) or expert is not None
+        ) and not algo.sharded_opt_state
         # ZeRO-1: only opt/algo state carries the per-rank stacked axis;
-        # params stay replicated
-        opt_stacked = replicated and algo.sharded_opt_state and expert is None
+        # params stay replicated (model-parallel leaves: sharded in place)
+        opt_stacked = replicated and algo.sharded_opt_state
         _unstack = lambda t: jax.tree.map(lambda x: x[0], t)
         _stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
         # expert grads average over dp (+sp: partial-sequence contributions)
@@ -657,15 +666,18 @@ class BaguaTrainer:
                 algo_state = _stack(algo_state)
             return TrainState(state.step + 1, params, opt_state, algo_state), loss
 
-        if expert is not None:
+        if expert is not None and not algo.sharded_opt_state:
             pspec = P((expert,))
             state_specs = TrainState(step=P(), params=pspec, opt_state=pspec,
                                      algo_state=pspec)
         elif opt_stacked:
             # ZeRO-1: bucket chunk states stacked over the comm axes; with
-            # tp/pp, params and the "local" state part carry the model-
+            # tp/pp/ep, params and the "local" state part carry the model-
             # parallel placements
-            pspec = self._param_specs if self._shard_axis is not None else P()
+            pspec = (
+                self._param_specs
+                if self._shard_axis is not None or expert is not None else P()
+            )
             state_specs = TrainState(step=P(), params=pspec,
                                      opt_state=self._zero_opt_specs,
                                      algo_state=P(self.comm_axes))
@@ -742,7 +754,9 @@ class BaguaTrainer:
     def _make_eval_fn(self, state_specs, batch_spec):
         algo = self.algorithm
         expert = self.expert_axis
-        stacked = (not algo.replicated_params) or expert is not None
+        stacked = (
+            (not algo.replicated_params) or expert is not None
+        ) and not algo.sharded_opt_state
 
         def per_shard(state: TrainState, batch):
             params = state.params
@@ -987,7 +1001,9 @@ class BaguaTrainer:
         """Return params in user shape (for eval/checkpoint): rank 0's copy
         for replicated/gossip state; global ``[n_experts, ...]`` expert leaves
         re-assembled from their ep shards."""
-        if self.expert_axis is None:
+        if self.expert_axis is None or self.algorithm.sharded_opt_state:
+            # ZeRO keeps expert leaves as global [n_experts, ...] arrays
+            # (sharded in place), so no re-assembly is needed
             if self.algorithm.replicated_params:
                 return state.params
             return jax.tree.map(lambda x: x[0], state.params)

@@ -194,7 +194,10 @@ def generate_tp(
     # one compiled fn per (model, mesh, axis, budget, param structure) —
     # rebuilding jit(shard_map(...)) per call would re-trace the whole
     # decode scan every request (the _EAGER_CACHE lesson, communication.py)
-    cache_key = (model, mesh, tp_axis, n, jax.tree_util.tree_structure(pspecs))
+    # key includes the spec VALUES, not just the tree structure — a custom
+    # tp_param_dim mapping the same params to different dims must recompile
+    flat_specs, spec_tree = jax.tree_util.tree_flatten(pspecs)
+    cache_key = (model, mesh, tp_axis, n, spec_tree, tuple(flat_specs))
     fn = _TP_GEN_CACHE.get(cache_key)
     if fn is None:
         def per_shard(p, toks, key, temp):

@@ -126,20 +126,6 @@ def test_clip_global_norm_matches_optax():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
 
 
-def test_rejects_expert_axis():
-    from bagua_tpu.parallel.mesh import build_mesh
-
-    model = MLP(features=(8, NCLASS))
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
-
-    with pytest.raises(NotImplementedError):
-        trainer = BaguaTrainer(
-            _loss_fn(model), None, ZeroOptimizerAlgorithm(),
-            mesh=build_mesh({"dp": 4, "ep": 2}), expert_axis="ep",
-        )
-        trainer.init(params)
-
-
 def test_zero_with_tp_matches_replicated_adam():
     """ZeRO composed with tensor parallelism (dp=4 x tp=2): dense buckets
     take the reduce_scatter/all_gather path over dp, tp slices get the
@@ -267,3 +253,61 @@ def test_zero_clip_rejects_model_parallel():
     state = trainer.init(params)
     with pytest.raises(NotImplementedError, match="clip_global_norm"):
         trainer.train_step(state, trainer.shard_batch({"tokens": tokens}))
+
+
+def _moe_setup(ep=2, key=20):
+    from bagua_tpu.model_parallel.moe import MoEMLP, moe_lm_loss_fn
+    from bagua_tpu.model_parallel.moe.layer import globalize_expert_params
+    from bagua_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                            d_ff=64, max_seq_len=8, dtype=jnp.float32)
+    model = TransformerLM(
+        cfg,
+        mlp_factory=lambda i: (
+            lambda: MoEMLP(n_experts=2 * ep, d_ff=cfg.d_ff, ep_size=ep,
+                           dtype=jnp.float32)
+        ) if i == 1 else None,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(key), (8, 9), 0, 64)
+    params = globalize_expert_params(
+        model.init(jax.random.PRNGKey(key + 1), tokens[:2, :-1])["params"],
+        jax.random.PRNGKey(key + 2), ep_size=ep,
+    )
+    return model, moe_lm_loss_fn(model), tokens, params
+
+
+def test_zero_with_ep_matches_plain_moe():
+    """ZeRO composed with expert parallelism (dp=4 x ep=2): dense buckets
+    chunk over dp x ep, expert leaves get shard-local states placed P(ep)
+    — must equal the plain stacked-layout GradientAllReduce + adam run
+    elementwise."""
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    model, loss_fn, tokens, params = _moe_setup()
+    mesh = build_mesh({"dp": 4, "ep": 2})
+
+    def train(trainer):
+        st = trainer.init(params)
+        batch = trainer.shard_batch({"tokens": tokens})
+        for _ in range(4):
+            st, loss = trainer.train_step(st, batch)
+        return trainer.unstack_params(st), float(loss)
+
+    p_zero, loss_zero = train(BaguaTrainer(
+        loss_fn, None, ZeroOptimizerAlgorithm(optax.adam(1e-2)),
+        mesh=mesh, expert_axis="ep", autotune=False,
+    ))
+    p_plain, loss_plain = train(BaguaTrainer(
+        loss_fn, optax.adam(1e-2), GradientAllReduceAlgorithm(),
+        mesh=mesh, expert_axis="ep", autotune=False,
+    ))
+
+    np.testing.assert_allclose(loss_zero, loss_plain, atol=1e-5)
+    flat_z = jax.tree_util.tree_leaves_with_path(p_zero)
+    flat_p = dict(jax.tree_util.tree_leaves_with_path(p_plain))
+    for path, leaf in flat_z:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_p[path]), rtol=2e-5, atol=2e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
