@@ -144,13 +144,13 @@ class ZeroOptimizerAlgorithm(Algorithm):
             # ||avg grad||² = psum of each rank's chunk contributions
             # (bucket padding is zeros and does not perturb the norm).
             # Local (model-parallel) leaves are excluded: their slices live
-            # on tp/pp axes outside this communicator, so a correct global
-            # norm would need a second psum over those axes — ZeRO with
-            # clipping is supported for pure-dp/sp meshes only.
+            # on tp/pp/ep axes outside this communicator, so a correct
+            # global norm would need a second psum over those axes — ZeRO
+            # with clipping is supported for pure-dp/sp meshes only.
             if local_g:
                 raise NotImplementedError(
-                    "clip_global_norm with model-parallel (tp/pp) leaves "
-                    "is not supported"
+                    "clip_global_norm with model-parallel (tp/pp/expert) "
+                    "leaves is not supported"
                 )
             ssq = sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gchunks
