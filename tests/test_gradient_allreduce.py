@@ -103,6 +103,35 @@ def test_bf16_comm_dtype_close_to_full_precision():
         np.testing.assert_allclose(a, b, rtol=0, atol=3e-2)
 
 
+def test_bf16_comm_dtype_hierarchical():
+    """comm_dtype composes with the hierarchical (intra -> inter) path:
+    both allreduce stages run on the cast buffer, result tracks full
+    precision within bf16 rounding."""
+    import jax.numpy as jnp
+
+    from bagua_tpu.parallel.mesh import hierarchical_mesh
+
+    model = MLP(features=(16, NCLASS))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
+    loss_fn = _loss_fn(model)
+    xs, ys = _data(steps=3, seed=11)
+
+    outs = {}
+    for dtype in (None, jnp.bfloat16):
+        trainer = BaguaTrainer(
+            loss_fn, optax.sgd(0.1),
+            GradientAllReduceAlgorithm(hierarchical=True, comm_dtype=dtype),
+            mesh=hierarchical_mesh(intra_size=4), bucket_bytes=256,
+        )
+        st = trainer.init(params)
+        for s in range(xs.shape[0]):
+            st, _ = trainer.train_step(st, {"x": xs[s], "y": ys[s]})
+        outs[dtype] = st.params
+
+    for a, b in zip(jax.tree.leaves(outs[jnp.bfloat16]), jax.tree.leaves(outs[None])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=3e-2)
+
+
 def test_sum_vs_avg_scales_update():
     model = MLP(features=(8, NCLASS))
     params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, DIM)))["params"]
