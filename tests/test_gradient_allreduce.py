@@ -78,8 +78,6 @@ def test_bf16_comm_dtype_close_to_full_precision():
     """comm_dtype=bfloat16 halves wire bytes; the result must track the
     full-precision allreduce within bf16 rounding (bf16 keeps f32's
     exponent range, so no scale factor is involved)."""
-    import jax.numpy as jnp
-
     model = MLP(features=(16, NCLASS))
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, DIM)))["params"]
     loss_fn = _loss_fn(model)
@@ -107,7 +105,6 @@ def test_bf16_comm_dtype_hierarchical():
     """comm_dtype composes with the hierarchical (intra -> inter) path:
     both allreduce stages run on the cast buffer, result tracks full
     precision within bf16 rounding."""
-    import jax.numpy as jnp
 
     from bagua_tpu.parallel.mesh import hierarchical_mesh
 
@@ -127,6 +124,20 @@ def test_bf16_comm_dtype_hierarchical():
         for s in range(xs.shape[0]):
             st, _ = trainer.train_step(st, {"x": xs[s], "y": ys[s]})
         outs[dtype] = st.params
+
+    # anchor the nontrivial 2x4 hierarchical topology to a flat-mesh golden
+    # (avg-of-avg over equal groups == global avg); the bf16 run is then
+    # compared against the anchored full-precision run
+    flat = BaguaTrainer(
+        loss_fn, optax.sgd(0.1), GradientAllReduceAlgorithm(),
+        bucket_bytes=256,
+    )
+    st = flat.init(params)
+    for s in range(xs.shape[0]):
+        st, _ = flat.train_step(st, {"x": xs[s], "y": ys[s]})
+    for a, b in zip(jax.tree.leaves(outs[None]), jax.tree.leaves(st.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
 
     for a, b in zip(jax.tree.leaves(outs[jnp.bfloat16]), jax.tree.leaves(outs[None])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=0, atol=3e-2)
