@@ -262,6 +262,7 @@ class BaguaTrainer:
         self._speed_tracker = StatisticalAverage()
         self._last_report_time = time.time()
         self._last_speed_time = time.time()
+        self._manual_speed = False
         self._hyperparams_signature = None
 
     # ---- plan management -----------------------------------------------
@@ -748,8 +749,48 @@ class BaguaTrainer:
             with self._watchdog.watch(f"train_step[{self._step_counter}]"):
                 out = fn(state, batch)
                 device_fence(out[1])
+            self._auto_record_speed(batch)
             return out
-        return fn(state, batch)
+        out = fn(state, batch)
+        self._auto_record_speed(batch)
+        return out
+
+    def _auto_record_speed(self, batch) -> None:
+        """Feed the throughput tracker from the step itself (reference
+        measures its own speed with paired events in the forward-pre hook,
+        distributed.py:340-358).  The global batch's leading dim is the
+        sample count; dispatch cadence equals steady-state step cadence
+        because each step consumes the previous state, so the host paces to
+        device throughput.  An explicit :meth:`record_speed` call switches
+        to manual mode — autotune never silently scores 0 either way."""
+        if self._manual_speed or self._autotune_completed:
+            # manual mode, or nothing will ever read the tracker (the only
+            # consumer is the autotune check-in) — skip the per-step host work
+            return
+        leaves = jax.tree.leaves(batch)
+        if not leaves or not jnp.ndim(leaves[0]):
+            return
+        now = time.time()
+        dt = now - self._last_speed_time
+        self._last_speed_time = now
+        if dt > 0:
+            self._speed_tracker.record(leaves[0].shape[0] / dt)
+
+    def step_cost_analysis(self, state: TrainState, batch) -> Dict[str, Any]:
+        """XLA's cost model for the current compiled train step ("flops",
+        "bytes accessed", ...) — feeds bench.py's achieved-TFLOP/s and MFU
+        reporting and its physically-impossible-number sanity bound.
+        Returns {} when the backend can't provide one (no reference
+        counterpart; NCCL/CUDA expose no per-step cost model)."""
+        fn = self._get_step_fn()
+        try:
+            analysis = fn.lower(state, batch).compile().cost_analysis()
+        except Exception as e:  # pragma: no cover - backend-dependent
+            logger.info("step_cost_analysis unavailable: %s", e)
+            return {}
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        return dict(analysis) if analysis else {}
 
     def _make_eval_fn(self, state_specs, batch_spec):
         algo = self.algorithm
@@ -1016,9 +1057,19 @@ class BaguaTrainer:
         return jax.tree_util.tree_map_with_path(fix, state.params)
 
     def record_speed(self, n_samples: float):
-        """Feed the throughput tracker with an instantaneous rate
-        (reference's speed metrics, distributed.py:340-358)."""
+        """Manual override of the automatic per-step speed tracking: count
+        ``n_samples`` since the previous call (reference's speed metrics,
+        distributed.py:340-358).  Use when the batch pytree's leading dim is
+        not the sample count (e.g. token-weighted scoring)."""
         now = time.time()
+        if not self._manual_speed:
+            # first manual call: discard any auto-recorded samples (possibly
+            # in different units) and the auto-advanced interval — recording
+            # against it would double-count this step
+            self._manual_speed = True
+            self._speed_tracker = StatisticalAverage()
+            self._last_speed_time = now
+            return
         dt = now - self._last_speed_time
         self._last_speed_time = now
         if dt > 0:

@@ -1,0 +1,53 @@
+"""bench.py's achieved-rate sanity bound: physically impossible numbers must
+raise (round 1 shipped a ~10x-inflated img/s from broken timing; the bound
+exists so a measurement bug can never be recorded as a result again)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo")
+import bench  # noqa: E402
+
+
+class _FakeTrainer:
+    """Quacks like BaguaTrainer for _perf_fields: fixed cost analysis."""
+
+    def __init__(self, flops, nbytes):
+        self._analysis = {"flops": flops, "bytes accessed": nbytes}
+
+    def step_cost_analysis(self, state, batch):
+        return self._analysis
+
+
+def _kind():
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def test_perf_fields_reports_rates():
+    tr = _FakeTrainer(flops=1e12, nbytes=1e9)
+    # 10 steps in 1 s -> 10 TFLOP/s, 10 GB/s: plausible everywhere
+    fields = bench._perf_fields(tr, None, None, dt=1.0, timed=10, n_dev=1)
+    assert fields["tflops_achieved"] == 10.0
+    assert fields["hbm_gbps"] == 10
+    if _kind() in bench.PEAK_TFLOPS_BF16:
+        assert 0 < fields["mfu"] < 1
+
+
+def test_perf_fields_trips_on_impossible_compute():
+    # 1e12 flops/step at 10000 steps/s -> 10,000 TFLOP/s/chip: impossible on
+    # any known chip AND above the unknown-device ABSURD_TFLOPS bound, so
+    # this trips regardless of the platform running the test
+    tr = _FakeTrainer(flops=1e12, nbytes=1.0)
+    with pytest.raises(bench.BenchSanityError):
+        bench._perf_fields(tr, None, None, dt=1.0, timed=10000, n_dev=1)
+
+
+def test_perf_fields_empty_analysis_is_silent():
+    class _NoAnalysis:
+        def step_cost_analysis(self, state, batch):
+            return {}
+
+    assert bench._perf_fields(_NoAnalysis(), None, None, 1.0, 10, 1) == {}
