@@ -10,6 +10,14 @@ VPU, quantizes in-register, and writes only the u8 payload + two scalars back
 to HBM — halving the codec's HBM traffic, which is what bounds it (the math
 is trivially elementwise).
 
+Chunks bigger than VMEM can't do it in one: past ``_MAX_FUSED_ROWS`` the
+codec switches to a TILED two-pass — a min/max accumulation kernel (output
+block revisited across the tile grid axis, legal because the tile axis
+iterates fastest) followed by an elementwise quantize kernel.  Same HBM
+traffic as the XLA lowering at those sizes, but no VMEM ceiling: the fused
+path keeps its advantage where it matters (ByteGrad's default ~10 MB
+buckets yield ~1 MB per-rank chunks).
+
 Layout matches :mod:`.minmax_uint8` (same quantization formula, same
 ``(mn, mx, payload)`` triple), so the two implementations are drop-in
 interchangeable and golden-tested against each other.
@@ -42,6 +50,13 @@ def _padded_rows(chunk: int) -> int:
 # row 1 = mx (lane 0).  16 KiB per chunk of stats — noise next to the payload.
 _STATS_ROWS = 8
 
+# fused single-pass ceiling: a (rows, 128) f32 block costs rows*512 bytes in
+# VMEM and Mosaic stacks ~5x that (double buffering + the i32 quantize
+# intermediate); 2048 rows (1 MiB f32) keeps the kernel comfortably inside
+# the 16 MiB scoped-vmem budget.  Larger chunks take the tiled two-pass.
+_MAX_FUSED_ROWS = 2048
+_TILE_ROWS = 2048
+
 
 def _compress_kernel(x_ref, stats_ref, payload_ref, *, chunk: int):
     x = x_ref[:].astype(jnp.float32)
@@ -60,6 +75,51 @@ def _compress_kernel(x_ref, stats_ref, payload_ref, *, chunk: int):
     row = jax.lax.broadcasted_iota(jnp.int32, (_STATS_ROWS, _LANE), 0)
     stats_ref[:] = jnp.where(row == 0, mn, mx)
     # Mosaic has no direct f32<->u8 cast; hop through i32
+    payload_ref[:] = (level - lower).astype(jnp.int32).astype(jnp.uint8)
+
+
+def _minmax_tile_kernel(x_ref, stats_ref, *, chunk: int):
+    """Pass 1 of the tiled codec: accumulate a chunk's min/max over its
+    tiles.  The stats block maps to the same (chunk-indexed) output block
+    for every tile step j, so it accumulates in VMEM across the fast grid
+    axis and spills once per chunk."""
+    j = pl.program_id(1)
+    x = x_ref[:].astype(jnp.float32)
+    rows, lanes = x.shape
+    base = j * rows * lanes
+    flat_idx = (
+        base
+        + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) * lanes
+        + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    )
+    mask = flat_idx < chunk
+    mn_t = jnp.min(jnp.where(mask, x, jnp.inf))
+    mx_t = jnp.max(jnp.where(mask, x, -jnp.inf))
+    row = jax.lax.broadcasted_iota(jnp.int32, (_STATS_ROWS, _LANE), 0)
+    tile_stats = jnp.where(row == 0, mn_t, mx_t)
+
+    @pl.when(j == 0)
+    def _init():
+        stats_ref[:] = tile_stats
+
+    @pl.when(j > 0)
+    def _accum():
+        cur = stats_ref[:]
+        stats_ref[:] = jnp.where(
+            row == 0, jnp.minimum(cur, mn_t), jnp.maximum(cur, mx_t)
+        )
+
+
+def _quantize_tile_kernel(stats_ref, x_ref, payload_ref):
+    """Pass 2 of the tiled codec: elementwise quantize against the chunk's
+    final min/max (padding quantizes garbage that the caller slices off)."""
+    mn = stats_ref[0, 0]
+    mx = stats_ref[1, 0]
+    scale = LEVELS / (mx - mn + EPS)
+    upper = jnp.round(mx * scale)
+    lower = upper - LEVELS
+    x = x_ref[:].astype(jnp.float32)
+    level = jnp.clip(jnp.round(x * scale), lower, upper)
     payload_ref[:] = (level - lower).astype(jnp.int32).astype(jnp.uint8)
 
 
@@ -82,31 +142,70 @@ def compress_chunked_pallas(
     assert x.size % n_chunks == 0, (x.size, n_chunks)
     chunk = x.size // n_chunks
     rows = _padded_rows(chunk)
+    if rows > _MAX_FUSED_ROWS:
+        # round up to a whole number of tiles so the 2-D grid divides evenly
+        rows = -(-rows // _TILE_ROWS) * _TILE_ROWS
     padded = rows * _LANE
     xp = jnp.pad(
         x.reshape(n_chunks, chunk).astype(jnp.float32),
         ((0, 0), (0, padded - chunk)),
     ).reshape(n_chunks * rows, _LANE)
 
-    stats, payload = pl.pallas_call(
-        functools.partial(_compress_kernel, chunk=chunk),
-        grid=(n_chunks,),
-        in_specs=[
-            pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((_STATS_ROWS, _LANE), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_chunks * _STATS_ROWS, _LANE), jnp.float32),
-            jax.ShapeDtypeStruct((n_chunks * rows, _LANE), jnp.uint8),
-        ],
-        interpret=interpret,
-    )(xp)
+    if rows <= _MAX_FUSED_ROWS:
+        stats, payload = pl.pallas_call(
+            functools.partial(_compress_kernel, chunk=chunk),
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((_STATS_ROWS, _LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n_chunks * _STATS_ROWS, _LANE),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((n_chunks * rows, _LANE), jnp.uint8),
+            ],
+            interpret=interpret,
+        )(xp)
+    else:
+        n_tiles = rows // _TILE_ROWS
+        stats = pl.pallas_call(
+            functools.partial(_minmax_tile_kernel, chunk=chunk),
+            grid=(n_chunks, n_tiles),
+            in_specs=[
+                pl.BlockSpec((_TILE_ROWS, _LANE),
+                             lambda i, j: (i * n_tiles + j, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((_STATS_ROWS, _LANE), lambda i, j: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(
+                (n_chunks * _STATS_ROWS, _LANE), jnp.float32
+            ),
+            interpret=interpret,
+        )(xp)
+        payload = pl.pallas_call(
+            _quantize_tile_kernel,
+            grid=(n_chunks, n_tiles),
+            in_specs=[
+                pl.BlockSpec((_STATS_ROWS, _LANE), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((_TILE_ROWS, _LANE),
+                             lambda i, j: (i * n_tiles + j, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((_TILE_ROWS, _LANE),
+                                   lambda i, j: (i * n_tiles + j, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((n_chunks * rows, _LANE),
+                                           jnp.uint8),
+            interpret=interpret,
+        )(stats, xp)
     payload = payload.reshape(n_chunks, padded)[:, :chunk]
     stats = stats.reshape(n_chunks, _STATS_ROWS, _LANE)
     return stats[:, 0, 0], stats[:, 1, 0], payload
@@ -119,6 +218,9 @@ def decompress_chunked_pallas(
     """Inverse of :func:`compress_chunked_pallas`; returns flat f32."""
     n_chunks, chunk = payload.shape
     rows = _padded_rows(chunk)
+    tiled = rows > _MAX_FUSED_ROWS
+    if tiled:
+        rows = -(-rows // _TILE_ROWS) * _TILE_ROWS
     padded = rows * _LANE
     pp = jnp.pad(payload, ((0, 0), (0, padded - chunk))).reshape(
         n_chunks * rows, _LANE
@@ -127,17 +229,25 @@ def decompress_chunked_pallas(
     block = jnp.zeros((n_chunks, _STATS_ROWS, _LANE), jnp.float32)
     block = block.at[:, 0, 0].set(mn.astype(jnp.float32))
     block = block.at[:, 1, 0].set(mx.astype(jnp.float32))
+    if tiled:
+        n_tiles = rows // _TILE_ROWS
+        grid = (n_chunks, n_tiles)
+        stats_spec = pl.BlockSpec((_STATS_ROWS, _LANE), lambda i, j: (i, 0),
+                                  memory_space=pltpu.VMEM)
+        data_spec = pl.BlockSpec((_TILE_ROWS, _LANE),
+                                 lambda i, j: (i * n_tiles + j, 0),
+                                 memory_space=pltpu.VMEM)
+    else:
+        grid = (n_chunks,)
+        stats_spec = pl.BlockSpec((_STATS_ROWS, _LANE), lambda i: (i, 0),
+                                  memory_space=pltpu.VMEM)
+        data_spec = pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         _decompress_kernel,
-        grid=(n_chunks,),
-        in_specs=[
-            pl.BlockSpec((_STATS_ROWS, _LANE), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
+        grid=grid,
+        in_specs=[stats_spec, data_spec],
+        out_specs=data_spec,
         out_shape=jax.ShapeDtypeStruct((n_chunks * rows, _LANE), jnp.float32),
         interpret=interpret,
     )(block.reshape(n_chunks * _STATS_ROWS, _LANE), pp)

@@ -772,6 +772,7 @@ class BaguaTrainer:
             return
         now = time.time()
         dt = now - self._last_speed_time
+        self._prev_speed_time = self._last_speed_time
         self._last_speed_time = now
         if dt > 0:
             self._speed_tracker.record(leaves[0].shape[0] / dt)
@@ -832,12 +833,16 @@ class BaguaTrainer:
         no reference counterpart hook (the reference evaluates on the raw
         torch module); here the jitted step owns the sharded params, so the
         trainer provides the entry point."""
-        if not hasattr(self, "_eval_fn"):
-            # reuse the train step's state layout: build (or fetch) the
-            # compiled step first, then lift its specs
-            self._get_step_fn()
+        # keyed like _get_step_fn: a rebucket / phase reset / autotune family
+        # switch that changes the state layout must not evaluate with stale
+        # specs (build or fetch the compiled step first, then lift its specs)
+        self._get_step_fn()
+        key = (self._plan.signature(), self._phase,
+               self.algorithm.hierarchical, type(self.algorithm).__name__)
+        if getattr(self, "_eval_key", None) != key:
             self._eval_fn = self._make_eval_fn(self._state_specs,
                                                self._batch_spec())
+            self._eval_key = key
         if self._watchdog is not None:
             # same hang-surfacing contract as train_step: a wedged eval
             # allreduce must trip the watchdog, not hang silently
@@ -907,8 +912,16 @@ class BaguaTrainer:
             if decl_buckets:
                 self.rebucket(decl_buckets)
                 self.bucket_bytes = recommended.bucket_size
-        # hierarchical toggle is only meaningful when the mesh has both tiers
-        if self._inter is not None and self._intra is not None:
+        # hierarchical toggle is only meaningful when the mesh has both
+        # tiers, and only for families that implement a staged path (ZeRO's
+        # constructor rejects hierarchical=True; flipping the attribute here
+        # would bypass that guard — autotune is force-disabled for
+        # sharded-opt-state families anyway, so this is belt-and-braces)
+        if (
+            self._inter is not None
+            and self._intra is not None
+            and not self.algorithm.sharded_opt_state
+        ):
             self.algorithm.hierarchical = bool(recommended.is_hierarchical_reduce)
 
     def _maybe_switch_algorithm(self, recommended) -> None:
@@ -1063,12 +1076,17 @@ class BaguaTrainer:
         not the sample count (e.g. token-weighted scoring)."""
         now = time.time()
         if not self._manual_speed:
-            # first manual call: discard any auto-recorded samples (possibly
-            # in different units) and the auto-advanced interval — recording
-            # against it would double-count this step
+            # first manual call: discard auto-recorded samples (possibly in
+            # different units), but DO record this one — against the
+            # interval the auto path measured for the same step (its
+            # pre-advance timestamp), not the microseconds since it ran —
+            # so a check-in landing before the second call never scores 0
             self._manual_speed = True
             self._speed_tracker = StatisticalAverage()
+            dt = now - getattr(self, "_prev_speed_time", self._last_speed_time)
             self._last_speed_time = now
+            if dt > 0:
+                self._speed_tracker.record(n_samples / dt)
             return
         dt = now - self._last_speed_time
         self._last_speed_time = now

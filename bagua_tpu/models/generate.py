@@ -23,7 +23,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["decode_model", "generate", "generate_tp"]
+__all__ = ["decode_model", "generate", "generate_tp",
+           "clear_tp_generate_cache"]
 
 
 def decode_model(model):
@@ -74,7 +75,18 @@ def _generate_core(model, params, prompt, max_new_tokens, rng, temperature):
 
 
 _generate_jit = partial(jax.jit, static_argnums=(0, 3))(_generate_core)
-_TP_GEN_CACHE: dict = {}
+# Bounded LRU of compiled tp-decode programs: long-lived serving processes
+# that vary prompt budgets or meshes must not accumulate executables (and
+# pin their mesh/device objects) forever.  8 distinct (model, mesh, budget,
+# sharding) signatures cover realistic serving; evictions just recompile.
+_TP_GEN_CACHE_MAX = 8
+_TP_GEN_CACHE: "dict" = {}  # insertion-ordered; move-to-end on hit
+
+
+def clear_tp_generate_cache() -> None:
+    """Drop every compiled tensor-parallel decode program (frees the
+    executables and releases their mesh references)."""
+    _TP_GEN_CACHE.clear()
 
 
 def generate(
@@ -198,7 +210,7 @@ def generate_tp(
     # tp_param_dim mapping the same params to different dims must recompile
     flat_specs, spec_tree = jax.tree_util.tree_flatten(pspecs)
     cache_key = (model, mesh, tp_axis, n, spec_tree, tuple(flat_specs))
-    fn = _TP_GEN_CACHE.get(cache_key)
+    fn = _TP_GEN_CACHE.pop(cache_key, None)
     if fn is None:
         def per_shard(p, toks, key, temp):
             return _generate_core(model, p, toks, n, key, temp)
@@ -207,5 +219,7 @@ def generate_tp(
             per_shard, mesh=mesh, in_specs=(pspecs, P(), P(), P()),
             out_specs=P(), check_vma=False,
         ))
-        _TP_GEN_CACHE[cache_key] = fn
+    _TP_GEN_CACHE[cache_key] = fn  # re-insert = move to most-recent
+    while len(_TP_GEN_CACHE) > _TP_GEN_CACHE_MAX:
+        _TP_GEN_CACHE.pop(next(iter(_TP_GEN_CACHE)))
     return fn(params, prompt, rng, jnp.float32(temperature))

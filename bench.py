@@ -125,15 +125,18 @@ def _time_steps(trainer, state, data, timed=TIMED_STEPS, warmup=WARMUP_STEPS):
     return dt, state, lossf
 
 
-def _perf_fields(trainer, state, data, dt, timed, n_dev) -> dict:
+def _perf_fields(trainer, state, data, dt, timed) -> dict:
     """Achieved TFLOP/s / MFU / HBM-bandwidth utilisation from XLA's cost
     model for the compiled step, plus the physically-impossible bound.
 
-    ``flops``/``bytes accessed`` are XLA's own counts for one step; dividing
-    by measured step time gives achieved rates.  A rate meaningfully above
-    the chip's peak is a measurement bug (see :class:`BenchSanityError`) —
-    the margins (1.25x compute, 1.5x bandwidth) absorb cost-model slack
-    while still catching the ~10x inflation that broken fencing produces."""
+    ``flops``/``bytes accessed`` are XLA's counts for one step of the
+    PER-DEVICE (SPMD-partitioned) executable — verified empirically: an
+    8-way-sharded matmul on the 8-device mesh reports 1/8 of the global
+    flops — so the rates below are already per-chip; no device division.
+    A rate meaningfully above the chip's peak is a measurement bug (see
+    :class:`BenchSanityError`) — the margins (1.25x compute, 1.5x
+    bandwidth) absorb cost-model slack while still catching the ~10x
+    inflation that broken fencing produces."""
     fields = {}
     analysis = trainer.step_cost_analysis(state, data)
     if not analysis:
@@ -142,7 +145,7 @@ def _perf_fields(trainer, state, data, dt, timed, n_dev) -> dict:
     steps_per_s = timed / dt
     flops = analysis.get("flops")
     if flops:
-        tflops = flops * steps_per_s / 1e12 / n_dev
+        tflops = flops * steps_per_s / 1e12
         fields["tflops_achieved"] = round(tflops, 1)
         peak = PEAK_TFLOPS_BF16.get(kind)
         if peak:
@@ -159,7 +162,7 @@ def _perf_fields(trainer, state, data, dt, timed, n_dev) -> dict:
             )
     nbytes = analysis.get("bytes accessed")
     if nbytes:
-        gbps = nbytes * steps_per_s / 1e9 / n_dev
+        gbps = nbytes * steps_per_s / 1e9
         fields["hbm_gbps"] = round(gbps)
         peak_bw = PEAK_HBM_GBPS.get(kind)
         if peak_bw:
@@ -198,7 +201,7 @@ def bench_family(family: str, algo_factory, mesh, n_dev: int) -> dict:
     data = trainer.shard_batch({"images": images, "labels": labels})
     try:
         dt, state, _ = _time_steps(trainer, state, data)
-        perf = _perf_fields(trainer, state, data, dt, TIMED_STEPS, n_dev)
+        perf = _perf_fields(trainer, state, data, dt, TIMED_STEPS)
     finally:
         if hasattr(algo, "abort"):  # stop the async averaging thread even
             algo.abort()           # when timing/sanity raises mid-record
@@ -342,7 +345,7 @@ def bench_vgg16(mesh, n_dev: int) -> dict:
     state = trainer.init(params)
     data = trainer.shard_batch({"images": images, "labels": labels})
     dt, state, _ = _time_steps(trainer, state, data)
-    perf = _perf_fields(trainer, state, data, dt, TIMED_STEPS, n_dev)
+    perf = _perf_fields(trainer, state, data, dt, TIMED_STEPS)
     per_device = TIMED_STEPS * batch / dt / n_dev
     return {
         "metric": "vgg16_gradient_allreduce_imgs_per_sec_per_chip",

@@ -29,7 +29,7 @@ def _kind():
 def test_perf_fields_reports_rates():
     tr = _FakeTrainer(flops=1e12, nbytes=1e9)
     # 10 steps in 1 s -> 10 TFLOP/s, 10 GB/s: plausible everywhere
-    fields = bench._perf_fields(tr, None, None, dt=1.0, timed=10, n_dev=1)
+    fields = bench._perf_fields(tr, None, None, dt=1.0, timed=10)
     assert fields["tflops_achieved"] == 10.0
     assert fields["hbm_gbps"] == 10
     if _kind() in bench.PEAK_TFLOPS_BF16:
@@ -42,7 +42,7 @@ def test_perf_fields_trips_on_impossible_compute():
     # this trips regardless of the platform running the test
     tr = _FakeTrainer(flops=1e12, nbytes=1.0)
     with pytest.raises(bench.BenchSanityError):
-        bench._perf_fields(tr, None, None, dt=1.0, timed=10000, n_dev=1)
+        bench._perf_fields(tr, None, None, dt=1.0, timed=10000)
 
 
 def test_perf_fields_empty_analysis_is_silent():
@@ -50,4 +50,4 @@ def test_perf_fields_empty_analysis_is_silent():
         def step_cost_analysis(self, state, batch):
             return {}
 
-    assert bench._perf_fields(_NoAnalysis(), None, None, 1.0, 10, 1) == {}
+    assert bench._perf_fields(_NoAnalysis(), None, None, 1.0, 10) == {}

@@ -116,3 +116,37 @@ def test_pallas_codec_matches_jnp_codec():
         y = decompress_chunked(mn, mx, p)
         y2 = decompress_chunked_pallas(mn2, mx2, p2, True)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y2), rtol=1e-6)
+
+
+def test_pallas_codec_tiled_large_chunks():
+    """Chunks past the single-pass VMEM ceiling must take the tiled two-pass
+    and still match the jnp codec exactly (the fused path VMEM-OOMed at
+    ~8 MB chunks before the tiling existed)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bagua_tpu.compression.pallas_codec as PC
+    from bagua_tpu.compression.minmax_uint8 import (
+        compress_chunked, decompress_chunked,
+    )
+
+    # force the tiled path at test-friendly sizes: ceiling 32 rows, 32-row
+    # tiles -> a 2-chunk input of 24000 elems runs 3 tiles per chunk with
+    # a ragged final tile
+    orig_max, orig_tile = PC._MAX_FUSED_ROWS, PC._TILE_ROWS
+    PC._MAX_FUSED_ROWS, PC._TILE_ROWS = 32, 32
+    try:
+        x = jax.random.normal(jax.random.PRNGKey(3), (2 * 12000,)).astype(
+            jnp.float32
+        )
+        mn, mx, p = compress_chunked(x, 2)
+        mn2, mx2, p2 = PC.compress_chunked_pallas(x, 2, True)
+        np.testing.assert_allclose(np.asarray(mn), np.asarray(mn2), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(mx), np.asarray(mx2), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(p2))
+        y = decompress_chunked(mn, mx, p)
+        y2 = PC.decompress_chunked_pallas(mn2, mx2, p2, True)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y2), rtol=1e-6)
+    finally:
+        PC._MAX_FUSED_ROWS, PC._TILE_ROWS = orig_max, orig_tile

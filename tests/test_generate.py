@@ -133,3 +133,31 @@ def test_tp_generation_validation():
     mesh = build_mesh({"tp": 2}, jax.devices()[:2])
     with pytest.raises(ValueError, match="tp_axis"):
         generate_tp(model, params, prompt, 4, mesh)  # cfg carries no tp
+
+
+def test_tp_gen_cache_is_bounded(monkeypatch):
+    """The compiled tp-decode cache must not grow without bound (serving
+    processes vary budgets/prompt shapes); eviction is LRU."""
+    import bagua_tpu.models.generate as G
+
+    from bagua_tpu.models.transformer import tp_param_dim
+    from bagua_tpu.parallel.mesh import build_mesh
+    from bagua_tpu.parallel.tensor_parallel import globalize_tp_params
+
+    monkeypatch.setattr(G, "_TP_GEN_CACHE_MAX", 2)
+    G.clear_tp_generate_cache()
+    cfg_tp = dataclasses.replace(CFG, tp_axis="tp", tp_size=2)
+    model = TransformerLM(cfg_tp)
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (2, 5), 0, 61)
+    params = globalize_tp_params(
+        model.init(jax.random.PRNGKey(12), prompt)["params"],
+        jax.random.PRNGKey(13), 2, tp_param_dim,
+    )
+    mesh = build_mesh({"tp": 2}, jax.devices()[:2])
+    for budget in (2, 3, 4):
+        G.generate_tp(model, params, prompt, budget, mesh)
+    assert len(G._TP_GEN_CACHE) == 2
+    budgets = sorted(k[3] for k in G._TP_GEN_CACHE)
+    assert budgets == [3, 4], "oldest entry (budget 2) must be evicted"
+    G.clear_tp_generate_cache()
+    assert not G._TP_GEN_CACHE

@@ -311,3 +311,22 @@ def test_zero_with_ep_matches_plain_moe():
             np.asarray(leaf), np.asarray(flat_p[path]), rtol=2e-5, atol=2e-5,
             err_msg=jax.tree_util.keystr(path),
         )
+
+
+def test_rejects_norm_coupled_optimizer():
+    """A norm-coupled transform (global-norm clipping) would silently train
+    on per-rank-chunk norms; construction must fail loudly."""
+    with pytest.raises(ValueError, match="ELEMENTWISE"):
+        ZeroOptimizerAlgorithm(
+            optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
+        )
+    # plain elementwise transforms pass the probe
+    ZeroOptimizerAlgorithm(optax.adamw(1e-3))
+    ZeroOptimizerAlgorithm(optax.sgd(0.1, momentum=0.9))
+
+
+def test_rejects_hierarchical():
+    """hierarchical= has no staged reduce-scatter implementation; silently
+    ignoring it only perturbed the step-cache key."""
+    with pytest.raises(NotImplementedError, match="hierarchical"):
+        ZeroOptimizerAlgorithm(optax.adam(1e-3), hierarchical=True)
