@@ -1,5 +1,7 @@
 """Minimum end-to-end example (reference examples/mnist/main.py equivalent):
-an MLP on a synthetic MNIST-shaped task with the gradient_allreduce algorithm.
+an MLP on real handwritten digits (the vendored 8x8 scans — see
+bagua_tpu/contrib/digits_data.py) with the gradient_allreduce algorithm;
+``--data synthetic`` switches to the MNIST-shaped synthetic teacher task.
 
 Run directly (single process, all local devices) or through the launcher:
 
@@ -38,7 +40,10 @@ def make_algorithm(name: str):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--algorithm", default="gradient_allreduce")
-    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--data", choices=("digits", "synthetic"), default="digits",
+                    help="real vendored digit scans (default) or the "
+                         "synthetic fixed-teacher task")
+    ap.add_argument("--steps", type=int, default=150)
     ap.add_argument("--batch-per-device", type=int, default=32)
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--seed", type=int, default=42)
@@ -46,16 +51,28 @@ def main():
 
     mesh = bagua_tpu.init_process_group()
     n_dev = len(jax.devices())
-    model = MLP(features=(128, 64, 10))
 
-    # synthetic, learnable MNIST-shaped task (fixed teacher)
     key = jax.random.PRNGKey(args.seed)
     k1, k2, k3 = jax.random.split(key, 3)
-    batch = args.batch_per_device * n_dev
-    x = jax.random.normal(k1, (batch, 28 * 28))
-    teacher = jax.random.normal(k2, (28 * 28, 10))
-    y = jnp.argmax(x @ teacher, axis=-1)
-    params = model.init(k3, x[:2])["params"]
+    x_test = y_test = None
+    if args.data == "digits":
+        from bagua_tpu.contrib.digits_data import load_digits_dataset
+
+        xt, yt, x_test, y_test = load_digits_dataset(train_multiple_of=n_dev)
+        x, y = jnp.asarray(xt), jnp.asarray(yt)  # full-batch (1.5k rows)
+        in_dim, lr = 64, 2e-3
+        model = MLP(features=(128, 64, 10))
+        opt_fn = lambda: optax.adam(lr)
+    else:
+        # synthetic, learnable MNIST-shaped task (fixed teacher)
+        batch = args.batch_per_device * n_dev
+        x = jax.random.normal(k1, (batch, 28 * 28))
+        teacher = jax.random.normal(k2, (28 * 28, 10))
+        y = jnp.argmax(x @ teacher, axis=-1)
+        in_dim = 28 * 28
+        model = MLP(features=(128, 64, 10))
+        opt_fn = lambda: optax.sgd(args.lr, momentum=0.9)
+    params = model.init(k3, jnp.zeros((2, in_dim)))["params"]
 
     def loss_fn(p, b):
         logits = model.apply({"params": p}, b["x"])
@@ -64,16 +81,21 @@ def main():
         ).mean()
 
     algo = make_algorithm(args.algorithm)
-    opt = None if algo.owns_optimizer else optax.sgd(args.lr, momentum=0.9)
+    opt = None if algo.owns_optimizer else opt_fn()
     trainer = bagua_tpu.BaguaTrainer(loss_fn, opt, algo, mesh=mesh,
                                      model_name="mnist_mlp")
     state = trainer.init(params)
+    batch_tree = trainer.shard_batch({"x": x, "y": y})
     for step in range(args.steps):
-        state, loss = trainer.train_step(state, {"x": x, "y": y})
-        trainer.record_speed(batch)
+        state, loss = trainer.train_step(state, batch_tree)
         if step % 20 == 0 or step == args.steps - 1:
             print(f"step {step} loss {float(loss):.6f}", flush=True)
     print(f"final_loss {float(loss):.6f}", flush=True)
+    if x_test is not None:
+        params = trainer.unstack_params(state)
+        logits = model.apply({"params": params}, jnp.asarray(x_test))
+        acc = float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(y_test)))
+        print(f"test_accuracy {acc:.4f}", flush=True)
 
 
 if __name__ == "__main__":

@@ -77,3 +77,14 @@ def test_parallelism_zoo_example():
 def test_generate_lm_example():
     out = _run_example("generate_lm.py")
     assert "generate_lm OK" in out
+
+
+@pytest.mark.slow
+def test_mnist_mlp_real_data_example():
+    """The flagship example trains on the REAL vendored digit scans by
+    default and must report a passing held-out accuracy (the reference CI
+    gates its mnist example on real data, benchmark_master.sh:83-108)."""
+    out = _run_example("mnist_mlp.py", "--steps", "150")
+    assert "test_accuracy" in out
+    acc = float(out.split("test_accuracy")[1].strip().split()[0])
+    assert acc >= 0.95, out

@@ -1,0 +1,158 @@
+"""Real-data accuracy gates (the reference's CI trains real MNIST/SQuAD and
+gates on deterministic outcomes, benchmark_master.sh:83-153; its examples
+consume real datasets, examples/mnist/main.py:1).
+
+These train on the REAL handwritten-digit scans packaged inside sklearn
+(see bagua_tpu/contrib/digits_data.py for why not MNIST itself: no network
+egress) through the full BaguaTrainer stack on the 8-device mesh, and
+assert held-out accuracy — demonstrating actual convergence, not just
+synthetic-loss movement, for the full-precision AND compressed families
+plus the expert-parallel MoE path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bagua_tpu.algorithms import ByteGradAlgorithm, GradientAllReduceAlgorithm
+from bagua_tpu.contrib.digits_data import load_digits_dataset
+from bagua_tpu.core.backend import BaguaTrainer
+from bagua_tpu.models.mlp import MLP
+from bagua_tpu.parallel.mesh import build_mesh
+
+N_DEVICES = 8
+
+
+def _accuracy(apply_fn, params, x, y):
+    logits = apply_fn(params, jnp.asarray(x))
+    return float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(y)))
+
+
+def _train_digits(algo, steps=150):
+    x_train, y_train, x_test, y_test = load_digits_dataset()
+    mesh = build_mesh({"dp": N_DEVICES})
+    model = MLP(features=(128, 64, 10))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 64)))["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    trainer = BaguaTrainer(
+        loss_fn,
+        None if algo.owns_optimizer else optax.adam(2e-3),
+        algo, mesh=mesh, autotune=False,
+    )
+    state = trainer.init(params)
+    batch = trainer.shard_batch(
+        {"x": jnp.asarray(x_train), "y": jnp.asarray(y_train)}
+    )
+    for i in range(steps):
+        state, loss = trainer.train_step(state, batch)
+        if i % 25 == 24:
+            # bound the async dispatch queue: XLA:CPU's in-process
+            # collective rendezvous hard-exits (rendezvous.cc termination
+            # timeout) when ~100+ queued 8-thread programs starve one
+            # participant thread — a simulation-platform hazard, absent on
+            # real TPU where the dispatch queue applies backpressure
+            float(loss)
+    params = trainer.unstack_params(state)
+    acc = _accuracy(
+        lambda p, xx: model.apply({"params": p}, xx), params, x_test, y_test
+    )
+    return acc, float(loss)
+
+
+@pytest.mark.slow
+def test_allreduce_reaches_97pct_on_real_digits():
+    acc, loss = _train_digits(GradientAllReduceAlgorithm())
+    assert acc >= 0.97, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
+
+
+@pytest.mark.slow
+def test_bytegrad_reaches_97pct_on_real_digits():
+    """uint8-compressed gradients must not cost real-data accuracy."""
+    acc, loss = _train_digits(ByteGradAlgorithm())
+    assert acc >= 0.97, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
+
+
+def _train_moe_digits(dropless: bool, k: int, steps=300):
+    """Expert-parallel MoE classifier on the real scans over an 8-way ep
+    mesh (the reference's MoE CI run is real-MNIST,
+    benchmark_master.sh:126-153).  The loss includes the gate's
+    load-balancing aux term (as moe_lm_loss_fn does) — without it top-1
+    routing collapses onto one expert and capacity overflow drops most
+    tokens (measured: 36% accuracy instead of 98%)."""
+    import flax.linen as nn
+
+    from bagua_tpu.model_parallel.moe import MoEMLP
+    from bagua_tpu.model_parallel.moe.layer import globalize_expert_params
+
+    x_train, y_train, x_test, y_test = load_digits_dataset()
+    ep = N_DEVICES
+    mesh = build_mesh({"ep": ep})
+
+    class MoEDigitsNet(nn.Module):
+        ep_size: int = 1
+
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(64)(x))
+            x = x[:, None, :]  # [B, 1, d] as tokens
+            x = MoEMLP(n_experts=ep, d_ff=128, ep_size=self.ep_size, k=k,
+                       dropless=dropless, capacity_factor=2.0)(x)
+            return nn.Dense(10)(x[:, 0, :])
+
+    model = MoEDigitsNet(ep_size=ep)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 64)))["params"]
+
+    def loss_fn(p, b):
+        logits, inter = model.apply(
+            {"params": p}, b["x"], mutable=["intermediates"]
+        )
+        nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+        aux = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree.leaves(inter["intermediates"]):
+            aux = aux + jnp.sum(leaf)
+        return nll + 0.01 * aux
+
+    trainer = BaguaTrainer(
+        loss_fn, optax.adam(5e-3), GradientAllReduceAlgorithm(),
+        mesh=mesh, expert_axis="ep", autotune=False,
+    )
+    state = trainer.init(
+        globalize_expert_params(params, jax.random.PRNGKey(1), ep_size=ep)
+    )
+    batch = trainer.shard_batch(
+        {"x": jnp.asarray(x_train), "y": jnp.asarray(y_train)}
+    )
+    for i in range(steps):
+        state, loss = trainer.train_step(state, batch)
+        if i % 25 == 24:
+            float(loss)  # bound the dispatch queue (see _train_digits)
+    params = trainer.unstack_params(state)  # experts back to global [E, ...]
+    dense_twin = MoEDigitsNet(ep_size=1)  # same param tree, no ep collectives
+    acc = _accuracy(
+        lambda p, xx: dense_twin.apply({"params": p}, xx),
+        params, x_test, y_test,
+    )
+    return acc, float(loss)
+
+
+@pytest.mark.slow
+def test_moe_ep_reaches_95pct_on_real_digits():
+    acc, loss = _train_moe_digits(dropless=False, k=2)
+    assert acc >= 0.95, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
+
+
+@pytest.mark.slow
+def test_moe_dropless_reaches_95pct_on_real_digits():
+    """The sort+gmm dropless path must also converge on real data."""
+    acc, loss = _train_moe_digits(dropless=True, k=1)
+    assert acc >= 0.95, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
