@@ -12,10 +12,15 @@ from .version import __version__  # noqa: F401
 
 from . import env  # noqa: F401
 from .communication import (  # noqa: F401
+    BaguaAborted,
     BaguaBackend,
     BaguaCommunicator,
     ReduceOp,
+    abort,
     allgather,
+    check_abort,
+    is_aborted,
+    reset_abort,
     allgather_inplace,
     allreduce,
     allreduce_inplace,

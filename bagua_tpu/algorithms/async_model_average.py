@@ -112,6 +112,15 @@ class AsyncModelAverageAlgorithm(Algorithm):
         """Between-steps swap point (the reference's weight lock boundary)."""
         import time
 
+        from ..communication import is_aborted
+
+        if is_aborted():
+            # the global abort flag (watchdog or user) stops the averaging
+            # control loop exactly like a local abort() call — no new
+            # rounds are launched, pending results are dropped
+            with self._lock:
+                self._pending = None
+            return state
         if self._status != _RUNNING or trainer._step_counter <= self.warmup_steps:
             return state
         self._ensure_avg_fn(trainer)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from enum import IntEnum
 from functools import lru_cache, partial
 from typing import Callable, List, Optional, Sequence, Tuple
@@ -55,6 +56,53 @@ class ReduceOp(IntEnum):
 
 def _tree_map(f, tree):
     return jax.tree.map(f, tree)
+
+
+# ---- abort flag (reference communicators/mod.rs:74-80, 456-471) -----------
+#
+# The reference exposes ``abort()``/``check_abort`` so a wedged collective
+# can be cancelled cooperatively and tested
+# (tests/comm/test_communicator.py:40-60).  XLA cannot cancel a compiled
+# program mid-flight, so the TPU rendering is a process-wide flag: new work
+# fails fast (the trainer checks it before every dispatch), background
+# control loops (async model average) stop launching rounds, and the
+# watchdog raises it before terminating a wedged process so cooperating
+# threads wind down first.
+
+_ABORT_EVENT = threading.Event()
+_ABORT_REASON: Optional[str] = None
+
+
+class BaguaAborted(RuntimeError):
+    """Raised by :func:`check_abort` after :func:`abort` was called."""
+
+
+def abort(reason: str = "user abort") -> None:
+    """Flag every communicator as aborted; in-flight XLA programs finish
+    (they cannot be cancelled) but no new communication is dispatched."""
+    global _ABORT_REASON
+    _ABORT_REASON = reason
+    _ABORT_EVENT.set()
+    logger.error("bagua_tpu: communication aborted: %s", reason)
+
+
+def is_aborted() -> bool:
+    return _ABORT_EVENT.is_set()
+
+
+def check_abort() -> None:
+    """Raise :class:`BaguaAborted` if :func:`abort` has been called
+    (reference ``check_abort``, communicators/mod.rs:74-80)."""
+    if _ABORT_EVENT.is_set():
+        raise BaguaAborted(_ABORT_REASON or "aborted")
+
+
+def reset_abort() -> None:
+    """Clear the abort flag (recovery path after the cause was handled —
+    the reference re-creates communicators after an abort)."""
+    global _ABORT_REASON
+    _ABORT_REASON = None
+    _ABORT_EVENT.clear()
 
 
 def collapse_trivial_axes(mesh: Mesh, axes) -> Tuple[str, ...]:

@@ -34,7 +34,7 @@ from ..bucket import BucketPlan, split_bucket_by_bucket_size
 from ..communication import BaguaCommunicator, ReduceOp, collapse_trivial_axes
 from ..parallel.mesh import build_mesh, hierarchical_mesh, mesh_axis_size
 from ..tensor import build_params, _name_of_path
-from ..utils import StatisticalAverage, device_fence
+from ..utils import StatisticalAverage
 
 logger = logging.getLogger(__name__)
 
@@ -717,6 +717,9 @@ class BaguaTrainer:
         return self._step_cache[key]
 
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
+        from ..communication import check_abort
+
+        check_abort()  # fail fast once a rank/watchdog flagged an abort
         self._step_counter += 1
         if self._profiler is not None:
             self._profiler.on_step(self._step_counter - 1)
@@ -740,18 +743,17 @@ class BaguaTrainer:
         ):
             self._report_tensor_execution_order(state, batch)
         fn = self._get_step_fn()
-        if self._watchdog is not None:
-            # synchronous under the watchdog: a cross-rank deadlock must
-            # surface as a stuck watched section, not an async no-op.  The
-            # fence is a host readback — block_until_ready can return while
-            # work is still queued on tunneled transports, which would blind
-            # the watchdog to real hangs
-            with self._watchdog.watch(f"train_step[{self._step_counter}]"):
-                out = fn(state, batch)
-                device_fence(out[1])
-            self._auto_record_speed(batch)
-            return out
         out = fn(state, batch)
+        if self._watchdog is not None:
+            # asynchronous watching: dispatch continues at full speed while
+            # the watchdog's waiter thread reads the loss back inside a
+            # watched section (a host readback — block_until_ready-family
+            # signals can return while work is still queued on tunneled
+            # transports, which would blind the watchdog to real hangs).
+            # A cross-rank deadlock pins the waiter past the timeout.
+            self._watchdog.watch_result(
+                out[1], f"train_step[{self._step_counter}]"
+            )
         self._auto_record_speed(batch)
         return out
 
@@ -843,14 +845,15 @@ class BaguaTrainer:
             self._eval_fn = self._make_eval_fn(self._state_specs,
                                                self._batch_spec())
             self._eval_key = key
+        from ..communication import check_abort
+
+        check_abort()
+        loss = self._eval_fn(state, batch)
         if self._watchdog is not None:
             # same hang-surfacing contract as train_step: a wedged eval
-            # allreduce must trip the watchdog, not hang silently
-            with self._watchdog.watch("eval_step"):
-                loss = self._eval_fn(state, batch)
-                device_fence(loss)
-            return loss
-        return self._eval_fn(state, batch)
+            # allreduce must pin the watchdog's waiter, not hang silently
+            self._watchdog.watch_result(loss, "eval_step")
+        return loss
 
     def _report_tensor_execution_order(self, state, batch) -> None:
         """Feed the sidecar the observed gradient-readiness order (the

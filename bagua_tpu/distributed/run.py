@@ -14,10 +14,15 @@ whole gang and restarts it (same world size) up to ``--max_restarts``, and
 workers resume from the latest checkpoint
 (:mod:`bagua_tpu.checkpoint`) — in-flight world-size *resizing* is impossible
 under XLA's static SPMD compilation, so MIN:MAX nnodes syntax is rejected
-rather than silently accepted.  Gang restart is **single-node only**: this
-launcher monitors its own subprocesses, so with ``--nnodes > 1`` restarts
-must come from the cluster manager re-launching every node together
-(``--max_restarts > 0`` is rejected there rather than silently node-local).
+rather than silently accepted.
+
+Multi-node gang restart (reference run.py:116-129 restarts the whole
+multi-node gang via the c10d rendezvous): each node's launcher coordinates
+through a tiny KV store (node 0 hosts a :class:`TCPStoreServer` on
+``--restart_coordinator_port``).  A node observing a local worker failure
+publishes a per-attempt failure flag; every launcher polls it, kills its
+own gang, joins a per-attempt ready barrier, and respawns together — so
+survivors never sit wedged in collectives while one node restarts alone.
 """
 
 from __future__ import annotations
@@ -49,9 +54,15 @@ def parse_args(argv=None):
     p.add_argument("--master_addr", type=str, default="127.0.0.1")
     p.add_argument("--master_port", type=int, default=29400)
     p.add_argument("--max_restarts", type=int, default=None,
-                   help="gang restarts after a worker failure (default 3; "
-                        "single-node only — multi-node defaults to 0)")
+                   help="gang restarts after a worker failure (default 3 "
+                        "single-node, 0 multi-node; multi-node restarts are "
+                        "coordinated through the restart KV store)")
     p.add_argument("--monitor_interval", type=float, default=1.0)
+    p.add_argument("--restart_coordinator_port", type=int, default=None,
+                   help="KV-store port for coordinated multi-node restarts "
+                        "(default master_port + 1; node 0 hosts it)")
+    p.add_argument("--restart_barrier_timeout", type=float, default=300.0,
+                   help="seconds to wait for every node at a restart barrier")
     # Bagua flags (reference run.py:360-398)
     p.add_argument("--bagua_service_port", type=int, default=29500)
     p.add_argument("--default_bucket_size", type=int, default=10 * 1024 ** 2)
@@ -74,17 +85,12 @@ def parse_args(argv=None):
         p.error("elastic MIN:MAX nnodes is not supported on TPU — world size "
                 "is fixed per launch; restart the job to resize")
     args.nnodes_int = int(args.nnodes)
-    if args.nnodes_int > 1 and (args.max_restarts or 0) > 0:
-        # Gang restart is node-local: this launcher only monitors its own
-        # node's workers, so restarting them after a remote failure would
-        # leave survivors hung in collectives and the restarted workers
-        # unable to rejoin the JAX coordination service.  Multi-node
-        # restart must come from the cluster manager re-launching every node.
-        p.error("gang restart (--max_restarts > 0) only supports single-node "
-                "launches; with --nnodes > 1 the cluster manager must "
-                "restart all nodes together")
     if args.max_restarts is None:
+        # multi-node default stays 0: coordinated restart requires every
+        # node's launcher to be started with the same max_restarts > 0
         args.max_restarts = 3 if args.nnodes_int == 1 else 0
+    if args.restart_coordinator_port is None:
+        args.restart_coordinator_port = args.master_port + 1
     return args
 
 
@@ -181,7 +187,176 @@ class _GangFailure(Exception):
         self.code = code
 
 
+def _connect_restart_store(args, timeout_s: float = 60.0):
+    """Client to node 0's restart KV store, with connect retries (peers may
+    start before the server is up)."""
+    from ..contrib.utils.tcp_store import TCPStore
+
+    deadline = time.time() + timeout_s
+    while True:
+        try:
+            return TCPStore(args.master_addr, args.restart_coordinator_port,
+                            timeout_s=timeout_s)
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+class _RestartStore:
+    """Reconnecting client: a transient socket error (timeout, reset) must
+    not permanently blind a node to remote failures — each op retries once
+    on a fresh connection before giving up."""
+
+    def __init__(self, args, connect_timeout_s: float = 60.0):
+        self._args = args
+        self._client = _connect_restart_store(args, connect_timeout_s)
+
+    def _retry(self, op):
+        try:
+            return op(self._client)
+        except (ConnectionError, OSError):
+            self._client = _connect_restart_store(self._args, timeout_s=5.0)
+            return op(self._client)
+
+    def set(self, key, value):
+        return self._retry(lambda c: c.set(key, value))
+
+    def get(self, key):
+        return self._retry(lambda c: c.get(key))
+
+    def mget(self, keys):
+        return self._retry(lambda c: c.mget(keys))
+
+
+def _store_barrier(store, nnodes: int, prefix: str, timeout_s: float,
+                   poll_s: float = 0.2) -> None:
+    deadline = time.time() + timeout_s
+    keys = [f"{prefix}/{r}" for r in range(nnodes)]
+    while True:
+        if all(v is not None for v in store.mget(keys)):
+            return
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"restart barrier {prefix!r} timed out after {timeout_s:.0f}s "
+                f"waiting for {nnodes} nodes"
+            )
+        time.sleep(poll_s)
+
+
+def monitor_multinode(args, procs, store, attempt: int) -> int:
+    """Like :func:`monitor`, but a failure ANYWHERE in the job surfaces
+    here: local failures are published to the per-attempt fail flag, and
+    the flag is polled so remote failures kill this node's gang too."""
+    fail_key = f"restart/fail/{attempt}"
+    store_down_since = None
+    while True:
+        codes = [p.poll() for p in procs]
+        failed = [c for c in codes if c not in (None, 0)]
+        if failed:
+            logger.warning("local worker failed (exit %d); publishing "
+                           "fail flag for attempt %d", failed[0], attempt)
+            try:
+                store.set(fail_key, str(args.node_rank))
+            except (ConnectionError, OSError):
+                logger.warning("restart store unreachable while publishing")
+            kill_gang(procs)
+            raise _GangFailure(failed[0])
+        remote = None
+        # poll remote failures; after repeated store loss back off to one
+        # probe per 30 s (the coordinator store is gone when node 0
+        # finished or died — a wedge here still dies via the worker
+        # watchdog -> local failure path)
+        if (
+            store_down_since is None
+            or time.time() - store_down_since > 30.0
+        ):
+            try:
+                remote = store.get(fail_key)
+                if store_down_since is not None:
+                    logger.info("restart store reachable again")
+                store_down_since = None
+            except (ConnectionError, OSError):
+                if store_down_since is None:
+                    logger.warning("restart store unreachable; monitoring "
+                                   "locally (reprobe every 30 s)")
+                store_down_since = time.time()
+        if remote is not None:
+            logger.warning("node %s reported failure; killing local gang",
+                           remote.decode())
+            kill_gang(procs)
+            raise _GangFailure(1)
+        if all(c == 0 for c in codes):
+            return 0
+        time.sleep(args.monitor_interval)
+
+
+def run_multinode(args) -> int:
+    """Coordinated multi-node gang restart (reference elastic_launch
+    restarts the whole multi-node gang on any failure, run.py:116-129).
+    Per attempt: ready barrier -> spawn -> monitor(+fail flag) -> on any
+    failure everyone kills, re-barriers, respawns."""
+    from ..contrib.utils.tcp_store import TCPStoreServer
+
+    server = None
+    if args.node_rank == 0:
+        # bind on all interfaces so peer nodes can reach the store
+        server = TCPStoreServer(host="0.0.0.0",
+                                port=args.restart_coordinator_port)
+    try:
+        store = _RestartStore(args)
+        attempt = 0
+        while True:
+            try:
+                store.set(f"restart/ready/{attempt}/{args.node_rank}", b"1")
+                _store_barrier(store, args.nnodes_int,
+                               f"restart/ready/{attempt}",
+                               args.restart_barrier_timeout)
+            except (ConnectionError, OSError, RuntimeError) as e:
+                # a peer exited the protocol (success or exhausted
+                # restarts) and the store/barrier is gone: restarting
+                # alone would wedge in collectives — give up cleanly
+                logger.error(
+                    "restart coordination lost at attempt %d (%s); "
+                    "cannot restart without all nodes", attempt, e,
+                )
+                return 1
+            procs = spawn_gang(args)
+            try:
+                rc = monitor_multinode(args, procs, store, attempt)
+                # done barrier: node 0 must keep the store alive until
+                # every node's monitor stopped polling it
+                try:
+                    store.set(f"restart/done/{args.node_rank}", b"1")
+                    if server is not None:
+                        _store_barrier(store, args.nnodes_int,
+                                       "restart/done", timeout_s=30.0)
+                except Exception:  # noqa: BLE001 - teardown is best-effort
+                    pass
+                return rc
+            except _GangFailure as f:
+                attempt += 1
+                if attempt > args.max_restarts:
+                    logger.error(
+                        "gang failed (exit %d); max_restarts=%d exhausted",
+                        f.code, args.max_restarts,
+                    )
+                    return f.code
+                logger.warning(
+                    "gang failed (exit %d); coordinated restart %d/%d",
+                    f.code, attempt, args.max_restarts,
+                )
+            except KeyboardInterrupt:
+                kill_gang(procs)
+                return 130
+    finally:
+        if server is not None:
+            server.stop()
+
+
 def run(args) -> int:
+    if args.nnodes_int > 1 and args.max_restarts > 0:
+        return run_multinode(args)
     attempt = 0
     while True:
         procs = spawn_gang(args)
@@ -205,7 +380,10 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    logging.basicConfig(level=logging.INFO)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
     return run(parse_args(argv))
 
 

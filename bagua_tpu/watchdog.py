@@ -5,14 +5,25 @@ when a scheduled comm op exceeds 300 s
 (/root/reference/rust/bagua-core/bagua-core-internal/src/lib.rs:255-265), and
 of its panic-escalation hook (bagua-core-py/src/lib.rs:518-523) — under XLA
 the analogous failure is a collective deadlock across ranks (e.g. one rank
-compiled a different program) that blocks ``block_until_ready`` forever.  A
-hung worker holds the whole gang; killing it lets
-``bagua_tpu.distributed.run``'s gang restart recover from the checkpoint.
+compiled a different program) that blocks forever.  A hung worker holds the
+whole gang; killing it lets ``bagua_tpu.distributed.run``'s gang restart
+recover from the checkpoint.
 
-Enabled via ``BAGUA_COMM_TIMEOUT_S`` (default off).  When on, the trainer
-synchronizes each step inside a watched section — trading step-level async
-dispatch for hang detection, the same serialization the reference's comm
-monitor implies.
+ON BY DEFAULT at the reference's 300 s (``BAGUA_COMM_TIMEOUT_S``; set 0/off
+to disable).  Always-on is affordable because watching is asynchronous: the
+trainer hands each step's loss array to a background *waiter* thread that
+performs the reliable host readback inside a watched section — the main
+thread keeps dispatching at full speed, and a wedged collective surfaces as
+the waiter stuck past the timeout.  (``jax.Array.is_ready`` polling would be
+cheaper still, but ``block_until_ready``-family signals have been observed
+returning early on tunneled transports; an actual readback is the fence that
+cannot lie.)
+
+On firing, the watchdog raises the cooperative abort flag
+(:func:`bagua_tpu.communication.abort`) so control loops stop, then dumps
+all thread stacks and terminates (``action="exit"``).  ``action="abort"``
+stops at the flag (in-process recovery; tests), ``action="log"`` only
+records.
 """
 
 from __future__ import annotations
@@ -20,6 +31,7 @@ from __future__ import annotations
 import faulthandler
 import logging
 import os
+import queue
 import sys
 import threading
 import time
@@ -28,27 +40,47 @@ from typing import Dict, Optional
 
 logger = logging.getLogger(__name__)
 
+DEFAULT_TIMEOUT_S = 300.0  # the reference's comm monitor bound (lib.rs:255)
+_OFF_VALUES = ("", "0", "off", "false", "no", "none")
+
 
 def get_comm_timeout_s() -> Optional[float]:
     v = os.environ.get("BAGUA_COMM_TIMEOUT_S")
-    return float(v) if v else None
+    if v is None:
+        return DEFAULT_TIMEOUT_S
+    if v.strip().lower() in _OFF_VALUES:
+        return None
+    return float(v)
 
 
 class HangWatchdog:
-    """Monitors watched sections; if one runs past ``timeout_s``, dumps all
-    thread stacks and terminates the process (``action="exit"``) or raises in
-    the monitor (``action="log"``, for tests)."""
+    """Monitors watched sections; if one runs past ``timeout_s``, raises the
+    global comm abort flag, then terminates the process (``action="exit"``),
+    stops at the flag (``action="abort"``), or just records
+    (``action="log"``, for tests).
+
+    Two watching styles:
+
+    * :meth:`watch` — context manager around blocking host work.
+    * :meth:`watch_result` — non-blocking: enqueue an async step result; the
+      internal waiter thread reads it back inside a watched section.
+    """
 
     _CHECK_INTERVAL_S = 1.0
+    _QUEUE_MAX = 64  # backlog cap; a hang pins the waiter on ONE item anyway
 
-    def __init__(self, timeout_s: float = 300.0, action: str = "exit"):
-        assert action in ("exit", "log")
+    def __init__(self, timeout_s: float = DEFAULT_TIMEOUT_S,
+                 action: str = "exit"):
+        assert action in ("exit", "abort", "log")
         self.timeout_s = timeout_s
         self.action = action
-        self.fired = threading.Event()
+        self.fired = threading.Event()  # informational latch (never cleared)
+        self._armed = True  # re-arms when all overdue sections clear
         self._active: Dict[int, tuple] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self._QUEUE_MAX)
+        self._waiter: Optional[threading.Thread] = None
         self._thread = threading.Thread(
             target=self._monitor, name="bagua-watchdog", daemon=True
         )
@@ -65,6 +97,40 @@ class HangWatchdog:
             with self._lock:
                 self._active.pop(token, None)
 
+    def watch_result(self, array, label: str = "step") -> None:
+        """Watch an async result without blocking the caller.  When the
+        backlog is full the item is dropped — safe, because a wedged
+        collective pins the waiter on whichever item it is currently
+        reading back, and every later step queues behind the same hang."""
+        if self._waiter is None:
+            with self._lock:
+                if self._waiter is None:
+                    self._waiter = threading.Thread(
+                        target=self._wait_loop, name="bagua-watchdog-waiter",
+                        daemon=True,
+                    )
+                    self._waiter.start()
+        try:
+            self._queue.put_nowait((label, array))
+        except queue.Full:
+            pass
+
+    def _wait_loop(self):
+        import numpy as np
+
+        while not self._stop.is_set():
+            try:
+                label, array = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            with self.watch(label):
+                try:
+                    np.asarray(array)  # host readback: the reliable fence
+                except Exception:
+                    # runtime errors surface on the main thread's own use
+                    # of the result; the watchdog only cares about hangs
+                    pass
+
     def _monitor(self):
         while not self._stop.wait(self._CHECK_INTERVAL_S):
             now = time.monotonic()
@@ -80,19 +146,33 @@ class HangWatchdog:
                     "watchdog: section %r stuck for %.0f s (timeout %.0f s) — "
                     "dumping stacks", label, dt, self.timeout_s,
                 )
-                already_fired = self.fired.is_set()
                 self.fired.set()
-                if not already_fired:  # dump stacks once, not every tick
+                if self._armed:
+                    # cooperative abort first: control loops (async model
+                    # average) stop launching work even in abort mode
+                    if self.action != "log":
+                        from .communication import abort
+
+                        abort(f"watchdog: {label} stuck for {dt:.0f} s")
+                    # dump stacks once per hang episode, not every tick
                     faulthandler.dump_traceback(file=sys.stderr)
+                    self._armed = False
                 if self.action == "exit":
                     # the gang-restart contract: die loudly, let the
                     # launcher respawn from the checkpoint
                     os._exit(3)
-                # log mode: keep monitoring (later hangs must also surface)
+                # abort/log modes: keep monitoring (later hangs surface too)
+            elif not self._armed:
+                # hang episode over (sections cleared, e.g. after
+                # reset_abort recovery): re-arm so the NEXT hang re-raises
+                # the abort flag and dumps stacks again
+                self._armed = True
 
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=5)
+        if self._waiter is not None:
+            self._waiter.join(timeout=5)
 
 
 _GLOBAL: Optional[HangWatchdog] = None

@@ -44,10 +44,17 @@ def main():
                     help="real vendored digit scans (default) or the "
                          "synthetic fixed-teacher task")
     ap.add_argument("--steps", type=int, default=150)
-    ap.add_argument("--batch-per-device", type=int, default=32)
-    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--batch-per-device", type=int, default=None,
+                    help="synthetic mode only (default 32); digits mode "
+                         "trains full-batch")
+    ap.add_argument("--lr", type=float, default=None,
+                    help="default: 2e-3 (adam) on digits, 0.05 (sgd+mom) "
+                         "synthetic")
     ap.add_argument("--seed", type=int, default=42)
     args = ap.parse_args()
+    if args.data == "digits" and args.batch_per_device is not None:
+        ap.error("--batch-per-device only applies to --data synthetic "
+                 "(digits trains full-batch)")
 
     mesh = bagua_tpu.init_process_group()
     n_dev = len(jax.devices())
@@ -60,12 +67,13 @@ def main():
 
         xt, yt, x_test, y_test = load_digits_dataset(train_multiple_of=n_dev)
         x, y = jnp.asarray(xt), jnp.asarray(yt)  # full-batch (1.5k rows)
-        in_dim, lr = 64, 2e-3
+        in_dim, lr = 64, (args.lr if args.lr is not None else 2e-3)
         model = MLP(features=(128, 64, 10))
         opt_fn = lambda: optax.adam(lr)
     else:
         # synthetic, learnable MNIST-shaped task (fixed teacher)
-        batch = args.batch_per_device * n_dev
+        args.lr = 0.05 if args.lr is None else args.lr
+        batch = (args.batch_per_device or 32) * n_dev
         x = jax.random.normal(k1, (batch, 28 * 28))
         teacher = jax.random.normal(k2, (28 * 28, 10))
         y = jnp.argmax(x @ teacher, axis=-1)

@@ -99,3 +99,62 @@ def test_multiprocess_bringup_trains_one_mesh(tmp_path):
     assert r0 == r1
     losses = eval(r0)
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.slow
+def test_multinode_coordinated_gang_restart(tmp_path):
+    """Two 'nodes' (one launcher process each, one worker each) form a
+    2-process JAX job.  Rank 1 (node 1's worker) crashes at step 5; BOTH
+    launchers must kill and respawn their gangs together via the restart
+    KV store (reference elastic_launch restarts the whole multi-node gang,
+    run.py:116-129) and training resumes from the checkpoint."""
+    import time as _time
+
+    master_port = _free_port()
+    coord_port = _free_port()
+    env = dict(os.environ)
+    env["BAGUA_TEST_OUT"] = str(tmp_path)
+    env["BAGUA_TEST_STEPS"] = "12"
+    env.pop("BAGUA_SERVICE_PORT", None)
+
+    def launch(node_rank, extra_env):
+        e = dict(env, **extra_env)
+        cmd = [
+            sys.executable, "-m", "bagua_tpu.distributed.run",
+            "--nnodes", "2", "--node_rank", str(node_rank),
+            "--nproc_per_node", "1",
+            "--simulate_cpu_devices", "1",
+            "--master_port", str(master_port),
+            "--restart_coordinator_port", str(coord_port),
+            "--bagua_service_port", "-1",
+            "--max_restarts", "2",
+            os.path.join(REPO, "tests", "workers",
+                         "multinode_elastic_worker.py"),
+        ]
+        return subprocess.Popen(
+            cmd, cwd=REPO, env=e, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
+    p0 = launch(0, {})
+    _time.sleep(0.5)  # let node 0 bind the restart store
+    p1 = launch(1, {"BAGUA_TEST_CRASH_AT_STEP": "5"})
+    out0 = out1 = ""
+    try:
+        out0 = p0.communicate(timeout=420)[0]
+        out1 = p1.communicate(timeout=60)[0]
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+    sys.stderr.write(out0[-2000:] + out1[-2000:])
+    assert p0.returncode == 0, out0[-2000:]
+    assert p1.returncode == 0, out1[-2000:]
+    # the crash happened on node 1 and both gangs restarted
+    assert "injected crash" in out1
+    assert "coordinated restart" in out0 and "coordinated restart" in out1
+    assert "resumed from checkpoint step" in out0
+    # both ranks finished with the identical replicated loss
+    f0 = (tmp_path / "final_rank0.txt").read_text()
+    f1 = (tmp_path / "final_rank1.txt").read_text()
+    assert f0 == f1

@@ -1,0 +1,95 @@
+"""Worker for the coordinated multi-node gang-restart test.
+
+Two single-process "nodes" (each its own launcher) bring up ONE 2-process
+JAX job.  Rank 1 injects a crash at step ``BAGUA_TEST_CRASH_AT_STEP`` on
+the first attempt (marker file suppresses repeats); both launchers must
+kill + respawn their gangs together and training resumes from the simple
+npz checkpoint (replicated state, every rank writes/reads identically —
+orbax is exercised elsewhere; this test targets the LAUNCHER protocol).
+"""
+
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import sys  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import bagua_tpu  # noqa: E402
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm  # noqa: E402
+from bagua_tpu.models.mlp import MLP  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    out_dir = os.environ["BAGUA_TEST_OUT"]
+    steps = int(os.environ.get("BAGUA_TEST_STEPS", "12"))
+    crash_at = int(os.environ.get("BAGUA_TEST_CRASH_AT_STEP", "-1"))
+    mesh = bagua_tpu.init_process_group()
+    assert jax.process_count() == world, (jax.process_count(), world)
+
+    model = MLP(features=(16, 8))
+    teacher = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    x_global = jax.random.normal(jax.random.PRNGKey(0), (8 * world, 4))
+    y_global = jnp.argmax(x_global @ teacher, -1)
+    params = model.init(jax.random.PRNGKey(2), x_global[:2])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    trainer = bagua_tpu.BaguaTrainer(
+        loss_fn, optax.sgd(0.2), GradientAllReduceAlgorithm(), mesh=mesh
+    )
+    state = trainer.init(params)
+
+    # replicated-state npz checkpoint: every rank saves/loads identically
+    ckpt = os.path.join(out_dir, "ckpt.npz")
+    start = 0
+    if os.path.exists(ckpt):
+        with np.load(ckpt) as z:
+            start = int(z["step"]) + 1
+            leaves, treedef = jax.tree.flatten(state)
+            state = jax.tree.unflatten(
+                treedef, [jnp.asarray(z[f"l{i}"]) for i in range(len(leaves))]
+            )
+        print(f"resumed from checkpoint step {start - 1}", flush=True)
+
+    lo, hi = rank * 8, (rank + 1) * 8
+    batch = trainer.shard_batch(
+        {"x": np.asarray(x_global[lo:hi]), "y": np.asarray(y_global[lo:hi])}
+    )
+    marker = os.path.join(out_dir, "crashed.marker")
+    for step in range(start, steps):
+        if (
+            rank == 1 and step == crash_at and not os.path.exists(marker)
+        ):
+            open(marker, "w").close()
+            print("injected crash", flush=True)
+            # abrupt death (as a real crash would be): sys.exit would run
+            # JAX's coordination-service shutdown, which BLOCKS until the
+            # wedged peer dies — hiding the exit from the launcher
+            os._exit(1)
+        state, loss = trainer.train_step(state, batch)
+        leaves = jax.tree.leaves(state)
+        arrays = {f"l{i}": np.asarray(x) for i, x in enumerate(leaves)}
+        if rank == 0:  # one writer is enough; state is replicated
+            np.savez(ckpt + ".tmp.npz", step=step, **arrays)
+            os.replace(ckpt + ".tmp.npz", ckpt)
+        print(f"step {step} loss {float(loss):.6f}", flush=True)
+
+    with open(os.path.join(out_dir, f"final_rank{rank}.txt"), "w") as f:
+        f.write(f"{float(loss):.6f}")
+    print(f"final_loss {float(loss):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
