@@ -1,0 +1,28 @@
+"""Docs gates: the committed API reference must match the source docstrings
+(the reference gates docs in CI via its Sphinx build, /root/reference/docs/)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_reference_up_to_date():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "gen_api_docs.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_doc_pages_exist():
+    for page in (
+        "docs/index.md",
+        "docs/api/index.md",
+        "docs/tutorials/porting.md",
+        "docs/tutorials/performance.md",
+    ):
+        assert os.path.exists(os.path.join(REPO, page)), page
