@@ -370,7 +370,11 @@ def init_process_group(
 # Semantics: the input's leading axis enumerates ranks (size == communicator
 # world size).  ``allreduce(x)[r] == reduce_r' x[r']`` for every r — exactly
 # what each process observes after the reference's synchronous collective.
-# On a multi-host mesh the leading axis is simply sharded across processes.
+#
+# Multi-process: each process passes ITS slice of the rank axis (usually a
+# leading axis of size 1 — the per-rank call shape of the reference API) and
+# _eager stitches the slices into one global array before dispatch, so the
+# reference's "every rank calls with its own tensor" usage ports directly.
 # ---------------------------------------------------------------------------
 
 
@@ -387,7 +391,20 @@ def _eager(comm: Optional[BaguaCommunicator], key, fn, *arrays):
     identifies the operation (name + static params) for the compile cache."""
     comm = comm if comm is not None else get_backend("").global_communicator
     mesh = comm.mesh
-    arrays = tuple(jnp.asarray(a) for a in arrays)
+    if jax.process_count() > 1:
+        # per-rank call semantics: each process contributes its own slice
+        # of the rank axis; host arrays are stitched into one global array
+        # (already-global jax.Arrays pass through untouched)
+        from .parallel.mesh import make_global_array
+
+        in_spec = P(comm.axis_name if len(comm.axes) == 1 else comm.axes)
+        arrays = tuple(
+            a if isinstance(a, jax.Array) and not a.is_fully_addressable
+            else make_global_array(mesh, in_spec, a)
+            for a in arrays
+        )
+    else:
+        arrays = tuple(jnp.asarray(a) for a in arrays)
     cache_key = (
         mesh, comm.axes, key,
         tuple((a.shape, a.dtype.name) for a in arrays),

@@ -53,6 +53,15 @@ class MoEMLP(nn.Module):
     #: all-to-all with exact per-destination counts (the reference's
     #: ``alltoall_v``, communicators/mod.rs:632-676) instead of dense
     #: capacity slots.
+    #:
+    #: Regime selection, MEASURED on v5e (E=8, k=2, d_model 512 — full
+    #: table in bench.py:bench_moe_dropless): capacity wins below ~12K
+    #: tokens per shard per layer (1.16x at 4K), dropless wins above
+    #: (1.49x at 32K, where capacity's O(T^2/E) dispatch tensor collapses
+    #: it).  The default stays False because the two paths have different
+    #: TRAINING semantics (capacity drops overflow tokens; dropless never
+    #: drops) — switching must be the user's modelling decision, made with
+    #: the perf table in hand.
     dropless: bool = False
     #: dropless EP transfer via ``lax.ragged_all_to_all`` (exact counts on
     #: the wire).  Off by default: XLA:CPU cannot execute the ragged HLO, so

@@ -57,6 +57,15 @@ def main():
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
 
+    # eager primitive with per-rank call semantics: each process passes its
+    # OWN slice (leading rank axis of size 1), as each reference rank passes
+    # its own tensor; the result is the cross-process reduction
+    contrib = np.full((1, 4), float(rank + 1), np.float32)
+    reduced = bagua_tpu.allreduce(contrib, op=bagua_tpu.ReduceOp.SUM)
+    expect = sum(range(1, world + 1))  # 1 + 2 + ... + world
+    local = np.asarray(reduced.addressable_shards[0].data)
+    assert np.allclose(local, expect), (local, expect)
+
     out = os.environ["BAGUA_TEST_OUT"]
     with open(os.path.join(out, f"rank{rank}.txt"), "w") as f:
         f.write(repr(losses))
