@@ -28,6 +28,7 @@ records.
 
 from __future__ import annotations
 
+import atexit
 import faulthandler
 import logging
 import os
@@ -187,4 +188,8 @@ def get_global_watchdog(timeout_s: float) -> HangWatchdog:
     with _GLOBAL_LOCK:
         if _GLOBAL is None:
             _GLOBAL = HangWatchdog(timeout_s)
+            # stop the waiter BEFORE interpreter teardown: a daemon thread
+            # killed mid-readback inside PJRT aborts the whole process at
+            # exit (SIGABRT after a perfectly good run)
+            atexit.register(_GLOBAL.stop)
         return _GLOBAL

@@ -10,12 +10,15 @@ before it terminates a wedged process.  Recovery = handle the cause, then
 ``reset_abort()``.
 """
 
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 import optax
 import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 import bagua_tpu
 from bagua_tpu.algorithms import GradientAllReduceAlgorithm
@@ -134,3 +137,49 @@ def test_user_abort_stops_async_loop():
         bagua_tpu.reset_abort()
     finally:
         algo.abort()
+
+
+def test_clean_interpreter_exit_with_watchdog(tmp_path):
+    """A script using the default-on watchdog must exit 0: the waiter
+    thread is stopped via atexit BEFORE interpreter teardown — a daemon
+    thread killed mid-readback inside the XLA runtime SIGABRTs the process
+    after an otherwise perfect run (observed on the driver bench path)."""
+    import subprocess
+    import sys as _sys
+
+    script = tmp_path / "drive.py"
+    script.write_text(
+        "import os, sys\n"
+        "os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        f"sys.path.insert(0, {str(REPO)!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp, optax\n"
+        "from bagua_tpu.algorithms import GradientAllReduceAlgorithm\n"
+        "from bagua_tpu.core.backend import BaguaTrainer\n"
+        "from bagua_tpu.models.mlp import MLP\n"
+        "from bagua_tpu.parallel.mesh import build_mesh\n"
+        "mesh = build_mesh({'dp': 8})\n"
+        "model = MLP(features=(16, 8))\n"
+        "params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 4)))['params']\n"
+        "def loss_fn(p, b):\n"
+        "    logits = model.apply({'params': p}, b['x'])\n"
+        "    return optax.softmax_cross_entropy_with_integer_labels(\n"
+        "        logits, b['y']).mean()\n"
+        "tr = BaguaTrainer(loss_fn, optax.sgd(0.1), GradientAllReduceAlgorithm(),\n"
+        "                  mesh=mesh, autotune=False)\n"
+        "state = tr.init(params)\n"
+        "batch = tr.shard_batch({'x': jnp.zeros((16, 4)),\n"
+        "                        'y': jnp.zeros((16,), jnp.int32)})\n"
+        "assert tr._watchdog is not None\n"
+        "for _ in range(10):\n"
+        "    state, loss = tr.train_step(state, batch)\n"
+        "print('done', float(loss))\n"
+    )
+    out = subprocess.run(
+        [_sys.executable, str(script)], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stdout[-500:] + out.stderr[-500:]
+    assert "done" in out.stdout
