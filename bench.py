@@ -336,13 +336,15 @@ def bench_bert(mesh, n_dev: int) -> dict:
     )
     state = trainer.init(params)
     data = trainer.shard_batch({"tokens": tokens})
-    dt, _, _ = _time_steps(trainer, state, data, timed=10)
+    dt, state, _ = _time_steps(trainer, state, data, timed=10)
+    perf = _perf_fields(trainer, state, data, dt, 10)
     seq_per_sec = 10 * batch / dt
     return {
         "metric": "bert_large_bytegrad_seqs_per_sec",
         "value": round(seq_per_sec, 2),
         "unit": "seq/s",
         "vs_baseline": None,
+        **perf,
     }
 
 
@@ -441,7 +443,7 @@ def bench_longctx(mesh, n_dev: int) -> dict:
     batch = 2 * n_dev
     tokens = jnp.zeros((batch, cfg.max_seq_len + 1), jnp.int32)
 
-    def run(attn_fn):
+    def run(attn_fn, want_perf=False):
         model = TransformerLM(cfg, attn_fn=attn_fn)
         params = model.init(jax.random.PRNGKey(0), tokens[:2, :128])["params"]
         trainer = BaguaTrainer(
@@ -451,11 +453,14 @@ def bench_longctx(mesh, n_dev: int) -> dict:
         )
         state = trainer.init(params)
         data = trainer.shard_batch({"tokens": tokens})
-        dt, _, _ = _time_steps(trainer, state, data, timed=10)
-        return 10 * batch * cfg.max_seq_len / dt
+        dt, state, _ = _time_steps(trainer, state, data, timed=10)
+        perf = (
+            _perf_fields(trainer, state, data, dt, 10) if want_perf else {}
+        )
+        return 10 * batch * cfg.max_seq_len / dt, perf
 
-    flash_tps = run(None)  # dispatches to the Pallas kernel on TPU
-    plain_tps = run(
+    flash_tps, perf = run(None, want_perf=True)  # Pallas kernel on TPU
+    plain_tps, _ = run(
         lambda q, k, v, dtype: reference_attention(q, k, v, dtype)
     )
     return {
@@ -463,6 +468,7 @@ def bench_longctx(mesh, n_dev: int) -> dict:
         "value": round(flash_tps, 0),
         "unit": "tok/s",
         "vs_baseline": round(flash_tps / plain_tps, 3),
+        **perf,
     }
 
 
