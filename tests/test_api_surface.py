@@ -27,6 +27,12 @@ SURFACE = [
     ("bagua_tpu", "broadcast"),
     ("bagua_tpu", "send_recv"),
     ("bagua_tpu", "barrier"),
+    # abort API (reference communicator abort/check_abort)
+    ("bagua_tpu", "abort"),
+    ("bagua_tpu", "check_abort"),
+    ("bagua_tpu", "is_aborted"),
+    ("bagua_tpu", "reset_abort"),
+    ("bagua_tpu", "BaguaAborted"),
     # algorithms
     ("bagua_tpu.algorithms", "Algorithm"),
     ("bagua_tpu.algorithms", "GradientAllReduceAlgorithm"),
