@@ -443,7 +443,7 @@ def bench_longctx(mesh, n_dev: int) -> dict:
 
     cfg = TransformerConfig(
         vocab_size=32768, d_model=1024, n_heads=16, n_layers=4, d_ff=4096,
-        max_seq_len=4096, remat=True,
+        max_seq_len=4096, remat=True, remat_policy="dots_no_batch",
     )
     batch = 2 * n_dev
     tokens = jnp.zeros((batch, cfg.max_seq_len + 1), jnp.int32)
