@@ -158,3 +158,56 @@ def test_multinode_coordinated_gang_restart(tmp_path):
     f0 = (tmp_path / "final_rank0.txt").read_text()
     f1 = (tmp_path / "final_rank1.txt").read_text()
     assert f0 == f1
+
+
+@pytest.mark.slow
+def test_multinode_exhausted_restarts_exit_nonzero(tmp_path):
+    """When the crash repeats past --max_restarts, BOTH launchers must give
+    up and exit nonzero (no hang at a barrier waiting for a peer that gave
+    up): rank 1 crashes on every attempt via BAGUA_TEST_CRASH_EVERY."""
+    import time as _time
+
+    master_port = _free_port()
+    coord_port = _free_port()
+    env = dict(os.environ)
+    env["BAGUA_TEST_OUT"] = str(tmp_path)
+    env["BAGUA_TEST_STEPS"] = "12"
+    env["BAGUA_COMM_TIMEOUT_S"] = "30"  # backstop for the wedged survivor
+    env.pop("BAGUA_SERVICE_PORT", None)
+
+    def launch(node_rank, extra_env):
+        e = dict(env, **extra_env)
+        cmd = [
+            sys.executable, "-m", "bagua_tpu.distributed.run",
+            "--nnodes", "2", "--node_rank", str(node_rank),
+            "--nproc_per_node", "1",
+            "--simulate_cpu_devices", "1",
+            "--master_port", str(master_port),
+            "--restart_coordinator_port", str(coord_port),
+            "--bagua_service_port", "-1",
+            "--max_restarts", "1",
+            "--restart_barrier_timeout", "60",
+            os.path.join(REPO, "tests", "workers",
+                         "multinode_elastic_worker.py"),
+        ]
+        return subprocess.Popen(
+            cmd, cwd=REPO, env=e, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+
+    p0 = launch(0, {})
+    _time.sleep(0.5)
+    p1 = launch(1, {"BAGUA_TEST_CRASH_EVERY": "1",
+                    "BAGUA_TEST_CRASH_AT_STEP": "3"})
+    out0 = out1 = ""
+    try:
+        out1 = p1.communicate(timeout=420)[0]
+        out0 = p0.communicate(timeout=120)[0]
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+    sys.stderr.write(out0[-1500:] + out1[-1500:])
+    assert p1.returncode not in (0, None), out1[-1500:]
+    assert p0.returncode not in (0, None), out0[-1500:]
+    assert "max_restarts=1 exhausted" in out1

@@ -69,8 +69,10 @@ def main():
     )
     marker = os.path.join(out_dir, "crashed.marker")
     for step in range(start, steps):
+        crash_every = os.environ.get("BAGUA_TEST_CRASH_EVERY") == "1"
         if (
-            rank == 1 and step == crash_at and not os.path.exists(marker)
+            rank == 1 and step == crash_at
+            and (crash_every or not os.path.exists(marker))
         ):
             open(marker, "w").close()
             print("injected crash", flush=True)
