@@ -156,3 +156,25 @@ def test_moe_dropless_reaches_95pct_on_real_digits():
     """The sort+gmm dropless path must also converge on real data."""
     acc, loss = _train_moe_digits(dropless=True, k=1)
     assert acc >= 0.95, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
+
+
+@pytest.mark.slow
+def test_qadam_reaches_94pct_on_real_digits():
+    """Compressed-momentum QAdam on real data (measured 95.2%: the uint8
+    momentum quantization costs ~3 points vs plain adam's 98.5% on this
+    tiny set — real accuracy still demonstrated, gated with margin)."""
+    from bagua_tpu.algorithms import QAdamAlgorithm
+
+    acc, loss = _train_digits(QAdamAlgorithm(warmup_steps=30), steps=200)
+    assert acc >= 0.94, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
+
+
+@pytest.mark.slow
+def test_decentralized_reaches_96pct_on_real_digits():
+    """Gossip (peer averaging) training on real data (measured 97.4%)."""
+    from bagua_tpu.algorithms.decentralized import DecentralizedAlgorithm
+
+    acc, loss = _train_digits(
+        DecentralizedAlgorithm(peer_selection_mode="all"), steps=250
+    )
+    assert acc >= 0.96, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
