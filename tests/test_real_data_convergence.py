@@ -178,3 +178,39 @@ def test_decentralized_reaches_96pct_on_real_digits():
         DecentralizedAlgorithm(peer_selection_mode="all"), steps=250
     )
     assert acc >= 0.96, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
+
+
+@pytest.mark.slow
+def test_low_precision_decentralized_reaches_96pct_on_real_digits():
+    """Compressed-difference ring gossip on real data (measured 98.1%)."""
+    from bagua_tpu.algorithms.decentralized import (
+        LowPrecisionDecentralizedAlgorithm,
+    )
+
+    acc, loss = _train_digits(LowPrecisionDecentralizedAlgorithm(), steps=250)
+    assert acc >= 0.96, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
+
+
+@pytest.mark.slow
+def test_zero_reaches_97pct_on_real_digits():
+    """ZeRO-1 sharded-optimizer training on real data (measured 98.5%)."""
+    from bagua_tpu.algorithms import ZeroOptimizerAlgorithm
+
+    acc, loss = _train_digits(ZeroOptimizerAlgorithm(optax.adam(2e-3)))
+    assert acc >= 0.97, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
+
+
+@pytest.mark.slow
+def test_async_reaches_95pct_on_real_digits():
+    """Async model averaging on real data (measured 97.0%; wider margin —
+    the averaging cadence is wall-clock dependent)."""
+    from bagua_tpu.algorithms.async_model_average import (
+        AsyncModelAverageAlgorithm,
+    )
+
+    algo = AsyncModelAverageAlgorithm(sync_interval_ms=50, warmup_steps=10)
+    try:
+        acc, loss = _train_digits(algo, steps=200)
+    finally:
+        algo.abort()
+    assert acc >= 0.95, f"held-out accuracy {acc:.3f} (final loss {loss:.4f})"
