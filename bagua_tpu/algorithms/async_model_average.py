@@ -15,16 +15,33 @@ semantics as the reference), and the result is swapped into the train state
 between steps — the functional equivalent of the reference's weight lock held
 during forward/backward (:156-168).  ``warmup_steps`` of synchronous gradient
 allreduce match the reference (:60, :125-131).
+
+Multi-process correctness: under XLA every process driving a shared mesh must
+dispatch the *same* global programs in the *same* order, so the reference's
+"launch a round whenever the local wall clock says so" gate
+(async_model_average.py:170-177) cannot be ported as-is — two hosts with
+skewed clocks would interleave the averaging collective differently against
+train steps and deadlock.  Instead the launch schedule is **deterministic in
+the step counter**: after warmup, a short calibration window measures the
+local step time, all processes agree on the slowest host's value (the
+reference's gloo side-channel, :59-60, here a tiny cross-process allgather),
+and rounds launch every ``k``-th step with ``k`` derived from
+``sync_interval_ms`` and the agreed step time.  ``abort``/``resume`` are
+likewise *negotiated*: a request only takes effect at the next scheduled
+boundary, simultaneously on every process (reference RESUME/ABORT
+negotiation each background round, :170-233).
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..communication import ReduceOp
@@ -32,8 +49,39 @@ from .base import Algorithm, AlgorithmContext
 
 logger = logging.getLogger(__name__)
 
-_RUNNING = "running"
-_ABORTED = "aborted"
+_RUNNING = 0
+_ABORTED = 1
+
+# per-boundary control intents (edge-triggered: consumed at negotiation, so
+# a later resume() from a DIFFERENT rank than the aborter still takes effect)
+_REQ_NONE = 0
+_REQ_RESUME = 1
+_REQ_ABORT = 2  # highest: abort wins when both are requested the same round
+
+
+def _agree_max(value: float, watchdog=None, label: str = "async-negotiate") -> float:
+    """All-process max of a host scalar (single-process: identity).
+
+    The cross-process control channel — plays the role of the reference's
+    gloo process group used for RESUME/ABORT negotiation
+    (async_model_average.py:59-60).  Every process must call this at the
+    same step boundary (the schedule guarantees that).  The blocking gather
+    runs inside a watchdog-watched section when one is supplied: a peer
+    dying between rounds would otherwise hang survivors here with no active
+    watched section to trip hang detection.
+    """
+    if jax.process_count() == 1:
+        return float(value)
+    from contextlib import nullcontext
+
+    from jax.experimental import multihost_utils
+
+    guard = watchdog.watch(label) if watchdog is not None else nullcontext()
+    with guard:
+        gathered = multihost_utils.process_allgather(
+            np.asarray(value, dtype=np.float64)
+        )
+    return float(np.max(gathered))
 
 
 class AsyncModelAverageAlgorithm(Algorithm):
@@ -44,24 +92,32 @@ class AsyncModelAverageAlgorithm(Algorithm):
         peer_selection_mode: str = "all",
         sync_interval_ms: int = 500,
         warmup_steps: int = 0,
+        calibration_steps: int = 4,
     ):
         """
         Args:
             peer_selection_mode: Only ``"all"`` is supported (as in the
                 reference async op).
-            sync_interval_ms: Minimum milliseconds between launching two
-                averaging rounds (reference sync_interval_ms).
+            sync_interval_ms: Target milliseconds between averaging rounds
+                (reference sync_interval_ms).  Converted to a step period at
+                calibration; ``0`` means every step.
             warmup_steps: Initial steps of synchronous gradient allreduce
                 before going asynchronous (reference :60).
+            calibration_steps: Steps used to measure the (slowest) host's
+                step time before the first round launches.
         """
         assert peer_selection_mode == "all"
         self.peer_selection_mode = peer_selection_mode
         self.sync_interval_ms = sync_interval_ms
         self.warmup_steps = warmup_steps
-        self._status = _RUNNING
+        self.calibration_steps = max(1, calibration_steps)
+        self._request = _REQ_NONE    # this rank's pending abort()/resume()
+        self._status = _RUNNING      # negotiated, changes only at boundaries
         self._pending: Optional[Any] = None
         self._avg_fn = None
-        self._last_launch = 0.0
+        self._period: Optional[int] = None   # agreed steps between rounds
+        self._anchor: Optional[int] = None   # step the schedule starts from
+        self._calib_t0: Optional[float] = None
         self._lock = threading.Lock()
 
     # ---- traced stages ---------------------------------------------------
@@ -108,35 +164,98 @@ class AsyncModelAverageAlgorithm(Algorithm):
         )
         self._snap_fn = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
 
+    def _apply_pending(self, state, watchdog=None):
+        """Drain the in-flight round into ``state`` (caller holds the lock).
+
+        Deterministic: every process launched the identical round at the
+        identical step, so every process drains it at the identical step.
+        The blocking wait is watchdog-fenced: a peer dying mid-collective
+        would otherwise hang survivors with no watched section active."""
+        from contextlib import nullcontext
+
+        avg_result, snapshot = self._pending
+        guard = (
+            watchdog.watch("async-drain") if watchdog is not None
+            else nullcontext()
+        )
+        with guard:
+            jax.block_until_ready(avg_result)
+        state = state._replace(
+            params=self._combine_fn(state.params, avg_result, snapshot)
+        )
+        self._pending = None
+        return state
+
+    def _calibrate(self, step: int, watchdog=None) -> None:
+        """Agree a launch period from the slowest host's measured step time
+        (replaces the reference's per-host wall-clock gate, :170-177)."""
+        # skip the first post-warmup step: it may include trace/compile time
+        start = self.warmup_steps + 2
+        if step == start:
+            self._calib_t0 = time.monotonic()
+        elif step == start + self.calibration_steps:
+            local_dt = (time.monotonic() - self._calib_t0) / self.calibration_steps
+            agreed_dt = _agree_max(local_dt, watchdog, "async-calibrate")
+            self._period = max(
+                1, int(round(self.sync_interval_ms / (agreed_dt * 1000.0)))
+            )
+            self._anchor = step
+            logger.info(
+                "async model average: agreed step time %.4fs (local %.4fs) "
+                "-> averaging every %d step(s)",
+                agreed_dt, local_dt, self._period,
+            )
+
     def host_pre_step(self, trainer, state):
         """Between-steps swap point (the reference's weight lock boundary)."""
-        import time
-
         from ..communication import is_aborted
 
         if is_aborted():
             # the global abort flag (watchdog or user) stops the averaging
             # control loop exactly like a local abort() call — no new
-            # rounds are launched, pending results are dropped
+            # rounds are launched, pending results are dropped; this process
+            # is about to exit for gang restart, so cross-rank agreement is
+            # moot here
             with self._lock:
                 self._pending = None
             return state
-        if self._status != _RUNNING or trainer._step_counter <= self.warmup_steps:
+        step = trainer._step_counter
+        if step <= self.warmup_steps:
             return state
-        self._ensure_avg_fn(trainer)
+        watchdog = getattr(trainer, "_watchdog", None)
         with self._lock:
+            if self._period is None:
+                self._calibrate(step, watchdog)
+                return state
+            if (step - self._anchor) % self._period != 0:
+                return state
+            # ---- scheduled boundary: negotiate, drain, launch ------------
+            # every process reaches this branch at the same step, so the
+            # control allgather and the collectives below line up globally.
+            # Requests are edge-triggered: consume BEFORE the blocking
+            # gather, so an abort()/resume() issued from another thread
+            # while the gather is in flight stays pending for the next
+            # boundary instead of being wiped.
+            my_req, self._request = self._request, _REQ_NONE
+            req = _agree_max(float(my_req), watchdog)
+            if req >= _REQ_ABORT:
+                new_status = _ABORTED
+            elif req >= _REQ_RESUME:
+                new_status = _RUNNING
+            else:
+                new_status = self._status
+            if new_status != self._status:
+                logger.info(
+                    "async model average: negotiated %s at step %d",
+                    "ABORT" if new_status == _ABORTED else "RESUME", step,
+                )
+            self._status = new_status
             if self._pending is not None:
-                avg_result, snapshot = self._pending
-                if all(l.is_ready() for l in jax.tree.leaves(avg_result)):
-                    state = state._replace(
-                        params=self._combine_fn(state.params, avg_result, snapshot)
-                    )
-                    self._pending = None
-            now = time.monotonic()
-            if (
-                self._pending is None
-                and (now - self._last_launch) * 1000.0 >= self.sync_interval_ms
-            ):
+                # the previous round was launched by all processes; drain it
+                # deterministically whether we stay running or just aborted
+                state = self._apply_pending(state, watchdog)
+            if self._status == _RUNNING:
+                self._ensure_avg_fn(trainer)
                 # snapshot = explicit copy (the reference op copies weights on
                 # the torch stream first, rs:50-60): the train step donates
                 # state.params, so the retained snapshot needs its own buffers
@@ -144,33 +263,31 @@ class AsyncModelAverageAlgorithm(Algorithm):
                 # dispatch is async: train steps keep running while the
                 # averaging collective is in flight
                 self._pending = (self._avg_fn(snapshot), snapshot)
-                self._last_launch = now
         return state
 
     # ---- control (reference :203-233) -----------------------------------
 
     def abort(self):
-        """Stop background averaging (e.g. before evaluation)."""
-        with self._lock:
-            self._status = _ABORTED
-            self._pending = None
-        logger.info("async model average aborted")
+        """Request a stop of background averaging (e.g. before evaluation).
+
+        Takes effect at the next scheduled boundary on ALL processes
+        simultaneously (the reference's negotiated ABORT, :203-218); may be
+        called from any single rank — and cleared by a ``resume()`` from any
+        rank, not just the one that aborted."""
+        self._request = _REQ_ABORT
+        logger.info("async model average abort requested")
 
     def resume(self):
-        """Resume background averaging."""
-        with self._lock:
-            self._status = _RUNNING
-        logger.info("async model average resumed")
+        """Request that background averaging resumes (negotiated RESUME)."""
+        self._request = _REQ_RESUME
+        logger.info("async model average resume requested")
 
     def barrier(self, trainer, state):
         """Drain any in-flight averaging and apply it (the reference's
-        post-abort synchronization)."""
+        post-abort synchronization).  Collective: call on every process."""
         with self._lock:
             if self._pending is not None:
-                avg_result, snapshot = self._pending
-                jax.block_until_ready(avg_result)
-                state = state._replace(
-                    params=self._combine_fn(state.params, avg_result, snapshot)
+                state = self._apply_pending(
+                    state, getattr(trainer, "_watchdog", None)
                 )
-                self._pending = None
         return state

@@ -95,11 +95,15 @@ class ZeroOptimizerAlgorithm(Algorithm):
         scale together), so a single step cannot expose clipping.  Runs on
         the CPU backend (tiny arrays; keeps TPU compile out of __init__)."""
         try:
-            device = jax.devices("cpu")[0]
+            # must be an ADDRESSABLE device: jax.devices("cpu")[0] is
+            # process 0's device, and committing the probe to it from any
+            # other process crashes that process alone — a divergent-dispatch
+            # hang (caught by tests/test_multiprocess_families.py[zero])
+            device = jax.local_devices(backend="cpu")[0]
         except RuntimeError:
             # CPU backend excluded (e.g. JAX_PLATFORMS=tpu): probe on the
             # default device — two tiny compiles, still worth the guard
-            device = jax.devices()[0]
+            device = jax.local_devices()[0]
         with jax.default_device(device):
             # norms 5, 0.14, 2.2: the clip factor changes per step, and
             # differs between the full vector and each half

@@ -13,11 +13,42 @@ calls out as required for the elastic workload.
 from __future__ import annotations
 
 import logging
+import threading
+import weakref
 from typing import Any, Optional, Tuple
 
 import jax
 
 logger = logging.getLogger(__name__)
+
+# live managers, so emergency paths (watchdog exit) can flush queued async
+# saves instead of losing them to os._exit skipping atexit handlers
+_LIVE_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def flush_all_checkpoints(timeout_s: float = 10.0) -> None:
+    """Best-effort flush of every live manager's queued async saves, bounded
+    by ``timeout_s`` — called by the watchdog before it terminates a wedged
+    process, where an unbounded ``wait_until_finished`` could itself hang."""
+    managers = list(_LIVE_MANAGERS)
+    if not managers:
+        return
+
+    def flush():
+        for m in managers:
+            try:
+                m.wait()
+            except Exception as e:  # pragma: no cover - backend-dependent
+                logger.warning("checkpoint flush failed: %s", e)
+
+    t = threading.Thread(target=flush, name="bagua-ckpt-flush", daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        logger.error(
+            "checkpoint flush did not finish within %.0f s — queued async "
+            "saves may be lost", timeout_s,
+        )
 
 
 class BaguaCheckpointManager:
@@ -45,6 +76,7 @@ class BaguaCheckpointManager:
             enable_async_checkpointing=async_save,
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        _LIVE_MANAGERS.add(self)
 
     def save(self, step: int, state: Any) -> bool:
         """Queue a save (async by default); returns False when skipped by the

@@ -223,6 +223,15 @@ class BaguaCommunicator:
         contrib = jnp.where(idx == src, x, jnp.zeros_like(x))
         return lax.psum(contrib, self.axes)
 
+    #: Largest step-pairing period precompiled into one program.  shift_one's
+    #: period is world/2, so this admits meshes to 256-way gossip out of the
+    #: box.  Measured on XLA:CPU (tests/test_compile_scale.py): the
+    #: ``lax.switch`` costs one ppermute instruction per period step — compile
+    #: time 0.06/0.08/0.31 s at 32/64/256 devices (flat in practice), program
+    #: text O(period × nranks).  The cap turns the far-out hazard (a pod-scale
+    #: gossip axis compiling thousands of branches) into an explicit error.
+    MAX_EXCHANGE_PERIOD = int(os.environ.get("BAGUA_MAX_EXCHANGE_PERIOD", "128"))
+
     def exchange_with_peer(self, x, peer_fn: Callable[[int, int, int], int], step):
         """Pairwise send/recv with a step-dependent symmetric pairing.
 
@@ -230,18 +239,32 @@ class BaguaCommunicator:
         step (peer(peer(r)) == r), as in the reference's shift_one exchange
         (decentralized_full_precision_synchronous.rs:79-83).  ``step`` may be a
         traced integer; the pairing must be periodic in ``step`` with period
-        dividing ``nranks`` (branches are precompiled with ``lax.switch``).
+        dividing ``nranks`` (branches are precompiled with ``lax.switch``; the
+        executed path is always exactly ONE ppermute — wire cost does not
+        grow with mesh size, only program metadata does, bounded by
+        :attr:`MAX_EXCHANGE_PERIOD`).
         """
         n = self.nranks()
         period_perms = []
         seen = {}
-        for s in range(n):
+        # stop enumerating as soon as the cap is provably exceeded — at pod
+        # scale the full table is O(n^2) tuples, pathological to even build
+        limit = min(n, self.MAX_EXCHANGE_PERIOD + 1)
+        for s in range(limit):
             perm = tuple((r, int(peer_fn(r, n, s))) for r in range(n))
             if perm in seen and s > 0:
                 break
             seen[perm] = s
             period_perms.append(perm)
         period = len(period_perms)
+        if period > self.MAX_EXCHANGE_PERIOD:
+            raise ValueError(
+                f"exchange_with_peer: pairing period exceeds the precompile "
+                f"cap {self.MAX_EXCHANGE_PERIOD} (program size grows as "
+                f"period x nranks).  Raise BAGUA_MAX_EXCHANGE_PERIOD to "
+                f"accept the compile cost, or use peer_selection_mode='all' "
+                f"on meshes this large."
+            )
         branches = [partial(lambda p, v: self.ppermute(v, p), list(p)) for p in period_perms]
         return lax.switch(step % period, branches, x)
 
@@ -371,10 +394,14 @@ def init_process_group(
 # world size).  ``allreduce(x)[r] == reduce_r' x[r']`` for every r — exactly
 # what each process observes after the reference's synchronous collective.
 #
-# Multi-process: each process passes ITS slice of the rank axis (usually a
-# leading axis of size 1 — the per-rank call shape of the reference API) and
-# _eager stitches the slices into one global array before dispatch, so the
-# reference's "every rank calls with its own tensor" usage ports directly.
+# Multi-process: each process passes ITS slice of the rank axis — one row per
+# communicator rank it OWNS.  Ranks are mesh positions (devices), so a process
+# driving one device passes a leading axis of size 1 (the per-rank call shape
+# of the reference API), while a process driving k local devices must pass all
+# k of its rows.  _eager validates the local leading dim against the owned
+# rank count and stitches the slices into one global array before dispatch,
+# so the reference's "every rank calls with its own tensor" usage ports
+# directly.
 # ---------------------------------------------------------------------------
 
 
@@ -384,6 +411,33 @@ def init_process_group(
 # per invocation
 _EAGER_CACHE: dict = {}
 
+# (mesh, axes) -> rank rows this process must feed; constant per mesh, and a
+# Python scan over every mesh device is too slow to repeat per eager call
+_OWNED_RANK_CACHE: dict = {}
+
+
+def _owned_rank_count(comm: "BaguaCommunicator") -> int:
+    """Number of DISTINCT rank-axis positions among this process's devices —
+    the per-process row count for eager per-rank call shapes.  Not a
+    proportional formula: with extra non-comm mesh axes a process's devices
+    can cover several — or repeat the same — rank indices."""
+    mesh = comm.mesh
+    key = (mesh, comm.axes)
+    cached = _OWNED_RANK_CACHE.get(key)
+    if cached is not None:
+        return cached
+    import numpy as _np
+
+    axis_idx = [mesh.axis_names.index(ax) for ax in comm.axes]
+    me = jax.process_index()
+    owned = {
+        tuple(coord[i] for i in axis_idx)
+        for coord, d in _np.ndenumerate(mesh.devices)
+        if d.process_index == me
+    }
+    _OWNED_RANK_CACHE[key] = len(owned)
+    return len(owned)
+
 
 def _eager(comm: Optional[BaguaCommunicator], key, fn, *arrays):
     """Run ``fn`` once per rank: inputs' leading axis is the rank axis; inside
@@ -392,11 +446,24 @@ def _eager(comm: Optional[BaguaCommunicator], key, fn, *arrays):
     comm = comm if comm is not None else get_backend("").global_communicator
     mesh = comm.mesh
     if jax.process_count() > 1:
-        # per-rank call semantics: each process contributes its own slice
-        # of the rank axis; host arrays are stitched into one global array
-        # (already-global jax.Arrays pass through untouched)
+        # per-rank call semantics: each process contributes one row per
+        # communicator rank (= mesh device) it owns; host arrays are
+        # stitched into one global array (already-global jax.Arrays pass
+        # through untouched)
         from .parallel.mesh import make_global_array
 
+        expected = _owned_rank_count(comm)
+        for a in arrays:
+            if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                continue
+            rows = jnp.shape(a)[0] if jnp.ndim(a) else None
+            if rows is not None and rows != expected:
+                raise ValueError(
+                    f"eager collective: this process owns {expected} of the "
+                    f"{comm.nranks()} communicator ranks and must pass "
+                    f"exactly that many rows along the leading rank axis, "
+                    f"got {rows}"
+                )
         in_spec = P(comm.axis_name if len(comm.axes) == 1 else comm.axes)
         arrays = tuple(
             a if isinstance(a, jax.Array) and not a.is_fully_addressable
@@ -606,8 +673,10 @@ def send_recv(send, peer_perm: List[Tuple[int, int]], comm=None):
 
 def barrier(comm=None):
     c = _comm_or_default(comm)
-    n = c.nranks()
+    # per-rank call shape: one row per rank THIS process owns (multi-process
+    # passes only its slice, like every other eager primitive)
+    rows = _owned_rank_count(c) if jax.process_count() > 1 else c.nranks()
     out = _eager(comm, ("barrier",),
                  lambda x: c.barrier() * jnp.ones((1,), jnp.int32),
-                 jnp.zeros((n, 1), jnp.int32))
+                 jnp.zeros((rows, 1), jnp.int32))
     jax.block_until_ready(out)

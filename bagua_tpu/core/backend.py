@@ -263,6 +263,7 @@ class BaguaTrainer:
         self._last_report_time = time.time()
         self._last_speed_time = time.time()
         self._manual_speed = False
+        self._skip_next_speed_sample = True
         self._hyperparams_signature = None
 
     # ---- plan management -----------------------------------------------
@@ -714,6 +715,9 @@ class BaguaTrainer:
             logger.info("bagua_tpu: compiling train step (phase=%s, %d buckets)",
                         self._phase, len(self._plan.buckets))
             self._step_cache[key] = self._make_step_fn(self._plan)
+            # the step that triggers this compile produces a garbage-slow
+            # speed sample; _auto_record_speed drops it
+            self._skip_next_speed_sample = True
         return self._step_cache[key]
 
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
@@ -776,6 +780,12 @@ class BaguaTrainer:
         dt = now - self._last_speed_time
         self._prev_speed_time = self._last_speed_time
         self._last_speed_time = now
+        if self._skip_next_speed_sample:
+            # this interval spanned trace+compile of a (re)built step — a
+            # garbage low sample that would skew the autotune score; start
+            # the clock here instead
+            self._skip_next_speed_sample = False
+            return
         if dt > 0:
             self._speed_tracker.record(leaves[0].shape[0] / dt)
 

@@ -82,6 +82,7 @@ class HangWatchdog:
         self._stop = threading.Event()
         self._queue: "queue.Queue" = queue.Queue(maxsize=self._QUEUE_MAX)
         self._waiter: Optional[threading.Thread] = None
+        self._readback_warned = False
         self._thread = threading.Thread(
             target=self._monitor, name="bagua-watchdog", daemon=True
         )
@@ -127,10 +128,20 @@ class HangWatchdog:
             with self.watch(label):
                 try:
                     np.asarray(array)  # host readback: the reliable fence
-                except Exception:
+                except Exception as e:
                     # runtime errors surface on the main thread's own use
-                    # of the result; the watchdog only cares about hangs
-                    pass
+                    # of the result; the watchdog only cares about hangs.
+                    # BUT an instantly-failing readback (donated/deleted
+                    # buffer, non-replicated global array) silently disarms
+                    # hang detection — make the degradation visible once.
+                    if not self._readback_warned:
+                        self._readback_warned = True
+                        logger.warning(
+                            "watchdog: readback of %r failed (%s: %s) — "
+                            "sections from watch_result() no longer fence "
+                            "device work; hang detection may be degraded",
+                            label, type(e).__name__, e,
+                        )
 
     def _monitor(self):
         while not self._stop.wait(self._CHECK_INTERVAL_S):
@@ -159,6 +170,16 @@ class HangWatchdog:
                     faulthandler.dump_traceback(file=sys.stderr)
                     self._armed = False
                 if self.action == "exit":
+                    # flush queued async checkpoint saves first — os._exit
+                    # skips atexit handlers, and the whole point of dying is
+                    # to restart from the freshest durable checkpoint.
+                    # Bounded: a wedged flush cannot block the exit.
+                    try:
+                        from .checkpoint import flush_all_checkpoints
+
+                        flush_all_checkpoints(timeout_s=10.0)
+                    except Exception:
+                        pass
                     # the gang-restart contract: die loudly, let the
                     # launcher respawn from the checkpoint
                     os._exit(3)
