@@ -10,13 +10,22 @@ from .gradient_allreduce import GradientAllReduceAlgorithm  # noqa: F401
 from .q_adam import QAdamAlgorithm, QAdamOptState  # noqa: F401
 from .zero import ZeroOptimizerAlgorithm  # noqa: F401
 
-#: Families the autotuner may switch between at a check-in (stateless,
-#: replicated, trainer-owned-optimizer algorithms only — swapping them never
-#: invalidates TrainState).  Gossip/owner families change the state layout
-#: and must be chosen up front.
+#: Families the autotuner may switch between at a check-in.  Stateless
+#: replicated trainer-owned-optimizer families (gradient_allreduce,
+#: bytegrad) swap freely; QAdam is switchable through the trainer's
+#: state-migration adapter (its momenta are param-shaped, so they can be
+#: adopted from an adam-family optax state — or start from zeros — and its
+#: warmup contract is re-anchored at the switch step; see
+#: ``BaguaTrainer._prepare_state_migration``).  Gossip/sharded families
+#: change the TrainState layout irreversibly and must be chosen up front.
 SWITCHABLE_ALGORITHMS = {
     "gradient_allreduce": lambda hierarchical: GradientAllReduceAlgorithm(
         hierarchical=hierarchical
     ),
     "bytegrad": lambda hierarchical: ByteGradAlgorithm(hierarchical=hierarchical),
+    # short warmup: the tuner samples this config for ~100 steps, so the
+    # compressed phase must begin well inside the scoring window
+    "qadam": lambda hierarchical: QAdamAlgorithm(
+        warmup_steps=20, hierarchical=hierarchical
+    ),
 }

@@ -86,6 +86,14 @@ class Algorithm:
         base.py:15-22, used by QAdam's warmup boundary)."""
         return False
 
+    def compile_key(self) -> tuple:
+        """Host-side state that changes the TRACED program (beyond the
+        phase counter).  Part of the trainer's compiled-step cache key —
+        without it, flipping such state (e.g. QAdam's ``_compressed`` after
+        an autotune switch re-anchors its warmup) would silently reuse a
+        stale compile."""
+        return ()
+
     def init_tensors(self, named_params: Sequence[NamedParam]) -> List[NamedParam]:
         """Which tensors to communicate, in registration order (reference
         base.py:24-49 registers grads in reversed module order — the caller

@@ -33,6 +33,7 @@ class QAdamOptState(NamedTuple):
 
 
 class QAdamAlgorithm(Algorithm):
+    name = "qadam"
     owns_optimizer = True
 
     def __init__(
@@ -66,6 +67,12 @@ class QAdamAlgorithm(Algorithm):
             self._compressed = True
             return True
         return False
+
+    def compile_key(self) -> tuple:
+        # the traced step branches on _compressed at trace time; an autotune
+        # switch back to qadam resets it to False mid-training, which must
+        # NOT reuse the compressed-phase compile
+        return (self._compressed,)
 
     def tensors_to_buckets(self, decl_buckets, named_params, world_size):
         from ..bucket import BucketPlan

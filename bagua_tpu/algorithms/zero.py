@@ -15,14 +15,19 @@ which costs exactly the same bytes on the wire as the allreduce it replaces
 byte audit in tests/test_hlo_comm_bytes.py), while storing only
 ``1/world_size`` of the optimizer state per chip.
 
-Locally it is NOT free: the params must be flattened into the bucket buffers
-each step (the optimizer updates rank chunks of the flat view) and the
-updated flats scattered back to leaves — traffic the leaf-wise allreduce
-update never pays.  Measured on one v5e chip (ResNet50, batch 128, where
-comm is a no-op and both families sit at the HBM roofline — 908 vs
-910 GB/s): ZeRO trails plain allreduce by ~7% (2464 vs 2640 img/s).  That
-is the single-chip price of the 1/world_size optimizer memory; on a real
-dp mesh the collective bytes are identical.
+On pure-dp meshes the params are FLAT-RESIDENT: ``TrainState.params`` holds
+the bucket flat buffers across steps and the trainer differentiates the
+loss w.r.t. the flats directly — the forward materializes leaf views by
+slicing (XLA fuses it) and autodiff's scatter-add IS the gradient flatten,
+so the per-step leaf->flat->leaf round trip the leaf layout paid is gone.
+Measured on one v5e chip (ResNet50, batch 128, comm a no-op, both families
+at the HBM roofline — 909 vs 920 GB/s): the leaf layout trailed plain
+allreduce by 7.7%; flat-resident trails by ~2% (2590 vs 2644 img/s, two
+runs), the residual being the per-step re-laying of updated flat segments
+into conv layouts.  That is the single-chip price of 1/world_size
+optimizer memory; on a real dp mesh the collective bytes are identical.
+Model-parallel compositions (tp/pp/ep) keep the leaf layout; leaf pytrees
+for eval/checkpoint/user code come from ``trainer.unstack_params(state)``.
 
 The wrapped optax transformation must be *elementwise* (adam, adamw, sgd,
 rmsprop, ...): the update for element ``i`` may depend only on gradient /
@@ -205,6 +210,14 @@ class ZeroOptimizerAlgorithm(Algorithm):
 
     def optimizer_update(self, ctx: AlgorithmContext, params, grads, opt_state,
                          algo_state, step):
+        if isinstance(params, dict) and "flats" in params:
+            # flat-resident layout (pure-dp meshes): the trainer already
+            # differentiates w.r.t. the bucket flats, so there is no
+            # leaf<->flat round trip here at all — reduce-scatter the flat
+            # grads, update the owned chunk, allgather back to flat
+            return self._optimizer_update_flat(
+                ctx, params, grads, opt_state, algo_state, step
+            )
         gflats = ctx.plan.flatten_tree(grads)
         pflats = ctx.plan.flatten_tree(params)
         # grad averaging and sharding in one collective per bucket
@@ -253,3 +266,29 @@ class ZeroOptimizerAlgorithm(Algorithm):
         new_params = tree_from_named(params, named)
         return new_params, {"buckets": tuple(new_states),
                             "local": local_state}, algo_state
+
+    def _optimizer_update_flat(self, ctx: AlgorithmContext, params, grads,
+                               opt_state, algo_state, step):
+        gchunks = [
+            ctx.comm.reduce_scatter(gf, ReduceOp.AVG)
+            for gf in grads["flats"]
+        ]
+        if self.clip_global_norm is not None:
+            ssq = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gchunks
+            )
+            gnorm = jnp.sqrt(ctx.comm.allreduce(ssq, ReduceOp.SUM))
+            scale = jnp.minimum(1.0, self.clip_global_norm / (gnorm + 1e-12))
+            gchunks = [(g * scale.astype(g.dtype)) for g in gchunks]
+
+        new_flats, new_states = [], []
+        for gchunk, pf, st in zip(gchunks, params["flats"],
+                                  opt_state["buckets"]):
+            pchunk = self._my_chunk(ctx, pf)
+            updates, st = self.optimizer.update(gchunk, st, pchunk)
+            pchunk = optax.apply_updates(pchunk, updates)
+            new_flats.append(ctx.comm.allgather(pchunk, tiled=True))
+            new_states.append(st)
+        new_params = {"flats": tuple(new_flats), "local": params["local"]}
+        return new_params, {"buckets": tuple(new_states),
+                            "local": opt_state["local"]}, algo_state

@@ -443,6 +443,7 @@ def _eager(comm: Optional[BaguaCommunicator], key, fn, *arrays):
     """Run ``fn`` once per rank: inputs' leading axis is the rank axis; inside
     ``fn`` each rank sees its own tensor (leading axis stripped).  ``key``
     identifies the operation (name + static params) for the compile cache."""
+    check_abort()  # aborted communicators fail new dispatches fast
     comm = comm if comm is not None else get_backend("").global_communicator
     mesh = comm.mesh
     if jax.process_count() > 1:
@@ -491,7 +492,38 @@ def _eager(comm: Optional[BaguaCommunicator], key, fn, *arrays):
             )
         )
         _EAGER_CACHE[cache_key] = compiled
-    return compiled(*arrays)
+    out = compiled(*arrays)
+    _watch_eager(out, key)
+    return out
+
+
+def _watch_eager(out, key) -> None:
+    """Fence standalone eager collectives with the global hang watchdog.
+
+    The trainer's steps are watchdog-fenced via ``watch_result``; without
+    this, a wedged ``allreduce()`` OUTSIDE the trainer would hang silently —
+    the reference's comm monitor covers every scheduled op, not only
+    training ones (bagua-core-internal/src/lib.rs:255-265)."""
+    from .watchdog import get_comm_timeout_s, get_global_watchdog
+
+    timeout = get_comm_timeout_s()
+    if timeout is None:
+        return
+    leaves = jax.tree_util.tree_leaves(out)
+    if not leaves:
+        return
+    leaf = leaves[0]
+    try:
+        # fence on ONE local shard, not the stacked global result: the
+        # shard's buffer is ready exactly when the collective completed
+        # locally, and the waiter's readback then transfers a single
+        # rank-row instead of the whole [nranks, ...] output
+        fence = leaf.addressable_shards[0].data
+    except Exception:
+        fence = leaf
+    get_global_watchdog(timeout).watch_result(
+        fence, f"eager:{key[0] if isinstance(key, tuple) else key}"
+    )
 
 
 def _comm_or_default(comm):
@@ -625,28 +657,46 @@ def broadcast(tensor, src: int = 0, comm=None):
     return _eager(comm, ("broadcast", src), lambda x: c.broadcast(x, src), tensor)
 
 
-def reduce(send, dst: int, op: ReduceOp = ReduceOp.SUM, comm=None):
-    """Only rank ``dst``'s slice holds the reduction; others keep their input
-    (reference communication.py:384-424 semantics)."""
+def reduce(send, dst: int, op: ReduceOp = ReduceOp.SUM, comm=None, recv=None):
+    """Only rank ``dst``'s slice holds the reduction (reference
+    communication.py:331-375: the collective writes ONLY dst's recv buffer).
+    Non-dst output slices reproduce ``recv`` — the functional analog of the
+    reference's untouched recv tensor — or zeros when no ``recv`` is given."""
     c = _comm_or_default(comm)
 
-    def fn(x):
+    if recv is None:
+        def fn(x):
+            red = c.allreduce(x, op)
+            return jnp.where(c.rank() == dst, red, jnp.zeros_like(red))
+
+        return _eager(comm, ("reduce", dst, int(op), False), fn, send)
+
+    def fn2(x, r):
         red = c.allreduce(x, op)
-        return jnp.where(c.rank() == dst, red, x)
+        return jnp.where(c.rank() == dst, red, r)
 
-    return _eager(comm, ("reduce", dst, int(op)), fn, send)
+    return _eager(comm, ("reduce", dst, int(op), True), fn2, send, recv)
 
 
-def gather(send, dst: int, comm=None):
+def gather(send, dst: int, comm=None, recv=None):
+    """Rank ``dst``'s output slice holds every rank's data concatenated
+    (``[nranks * rows, ...]``); non-dst slices reproduce ``recv`` — the
+    reference leaves their recv buffers untouched
+    (communication.py:576-614) — or zeros when no ``recv`` is given."""
     c = _comm_or_default(comm)
 
-    def fn(x):
-        g = c.allgather(x, axis=0, tiled=True)
-        n = c.nranks()
-        mine = jnp.concatenate([x] * n, axis=0)
-        return jnp.where(c.rank() == dst, g, mine)
+    if recv is None:
+        def fn(x):
+            g = c.allgather(x, axis=0, tiled=True)
+            return jnp.where(c.rank() == dst, g, jnp.zeros_like(g))
 
-    return _eager(comm, ("gather", dst), fn, send)
+        return _eager(comm, ("gather", dst, False), fn, send)
+
+    def fn2(x, r):
+        g = c.allgather(x, axis=0, tiled=True)
+        return jnp.where(c.rank() == dst, g, r)
+
+    return _eager(comm, ("gather", dst, True), fn2, send, recv)
 
 
 def scatter(send, src: int, comm=None):

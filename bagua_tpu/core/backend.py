@@ -39,6 +39,20 @@ from ..utils import StatisticalAverage
 logger = logging.getLogger(__name__)
 
 
+def _find_adam_moments(opt_state):
+    """Locate adam-family first/second moments inside a nested optax state
+    (``ScaleByAdamState``-like: has param-shaped ``mu`` and ``nu``).  Returns
+    ``(mu, nu)`` or None — feeds the QAdam switch adapter."""
+    if hasattr(opt_state, "mu") and hasattr(opt_state, "nu"):
+        return (opt_state.mu, opt_state.nu)
+    if isinstance(opt_state, (tuple, list)):
+        for item in opt_state:
+            found = _find_adam_moments(item)
+            if found is not None:
+                return found
+    return None
+
+
 class TrainState(NamedTuple):
     step: jax.Array        # int32 scalar, replicated
     params: Any
@@ -251,6 +265,10 @@ class BaguaTrainer:
         self._autotune_failures = 0
         self._autotune_completed = not self.autotune
         self._telemetry_reported = False
+        self._pending_state_migration = None
+        self._stashed_opt_state = None
+        self._zero_flat = False
+        self._param_template = None
 
         from ..watchdog import get_comm_timeout_s, get_global_watchdog
 
@@ -406,9 +424,18 @@ class BaguaTrainer:
     def init(self, params) -> TrainState:
         # copy: step buffers are donated, the caller keeps their params alive
         params = jax.tree.map(lambda x: jnp.array(x, copy=True), params)
+        # structure/shape/dtype template for rebuilding the leaf pytree from
+        # flat-resident layouts (ZeRO) in traced code
+        self._param_template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
+            params,
+        )
         self._plan = self._build_plan(params)
         if self.autotune and not self._autotune_completed:
             self._autotune_register_tensors()
+            # a family switch during registration needs no migration: the
+            # state below is built directly in the new family's layout
+            self._pending_state_migration = None
         plan = self._plan
         algo = self.algorithm
         ctx = self._ctx(plan)
@@ -453,6 +480,19 @@ class BaguaTrainer:
             # spec machinery as the gossip algorithms' per-rank state).
             # With tp/pp, the "local" state part mirrors the sharded leaves'
             # own placements (state protocol: {"buckets", "local"}).
+            #
+            # Pure-dp meshes use the FLAT-RESIDENT layout: params live as the
+            # bucket flat buffers across steps and the step differentiates
+            # w.r.t. the flats directly — the forward unflatten is fusable
+            # slicing and autodiff's scatter-add IS the gradient flatten, so
+            # the per-step leaf->flat->leaf round trip (the measured ~7%
+            # single-chip ZeRO overhead, VERDICT r3 #4) disappears.
+            # Model-parallel compositions keep the leaf layout.
+            self._zero_flat = (
+                self._shard_axis is None
+                and self.expert_axis is None
+                and self.pp_axis is None
+            )
             in_spec = P()
             local_spec = P()
             if self._shard_axis is not None or self.expert_axis is not None:
@@ -477,6 +517,26 @@ class BaguaTrainer:
                 local_spec = self._tp_match_spec_tree(local_struct, sharded)
             self._zero_opt_specs = {"buckets": P(self.comm_axes),
                                     "local": local_spec}
+
+            if self._zero_flat:
+
+                def init_fn_flat(p):
+                    a = algo.init_state(ctx, p)
+                    o = algo.init_optimizer_state_sharded(ctx, p)
+                    stack = lambda t: jax.tree.map(
+                        lambda x: jnp.asarray(x)[None], t)
+                    zp = {"flats": tuple(plan.flatten_tree(p)), "local": {}}
+                    return zp, {"buckets": stack(o["buckets"]),
+                                "local": o["local"]}, stack(a)
+
+                zparams, opt_state, algo_state = jax.jit(
+                    shard_map(init_fn_flat, mesh=mesh, in_specs=(in_spec,),
+                              out_specs=(P(), self._zero_opt_specs,
+                                         P(self.comm_axes)),
+                              check_vma=False)
+                )(params)
+                return TrainState(jnp.zeros((), jnp.int32), zparams,
+                                  opt_state, algo_state)
 
             def init_fn(p):
                 a = algo.init_state(ctx, p)
@@ -557,6 +617,22 @@ class BaguaTrainer:
             a for a in dp + ((self.seq_axis,) if self.seq_axis else ())
             if mesh.shape[a] > 1
         )
+        zero_flat = self._zero_flat
+        template = self._param_template
+        plan = self._plan
+
+        if zero_flat:
+            from ..tensor import tree_from_named
+
+            def loss_on(zp, b):
+                # flat-resident params: materialize the leaf view (slicing —
+                # XLA fuses it); autodiff w.r.t. zp scatters grads straight
+                # back into bucket-flat layout
+                named = plan.unflatten_to_named(zp["flats"])
+                named.update(zp["local"])
+                return self.loss_fn(tree_from_named(template, named), b)
+        else:
+            loss_on = self.loss_fn
 
         def per_shard(state: TrainState, batch):
             params = state.params
@@ -587,12 +663,12 @@ class BaguaTrainer:
 
                 def micro_step(carry, mb):
                     loss_sum, grad_sum = carry
-                    l, g = jax.value_and_grad(self.loss_fn)(params, mb)
+                    l, g = jax.value_and_grad(loss_on)(params, mb)
                     return (loss_sum + l, jax.tree.map(jnp.add, grad_sum, g)), None
 
                 # carry dtype must match micro_step's promoted loss dtype
                 mb0 = jax.tree.map(lambda x: x[0], microbatches)
-                loss_dtype = jax.eval_shape(self.loss_fn, params, mb0).dtype
+                loss_dtype = jax.eval_shape(loss_on, params, mb0).dtype
                 zero = (
                     jnp.zeros((), loss_dtype),
                     jax.tree.map(jnp.zeros_like, params),
@@ -601,7 +677,7 @@ class BaguaTrainer:
                 loss = loss / accum
                 grads = jax.tree.map(lambda g: g / accum, grads)
             else:
-                loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
+                loss, grads = jax.value_and_grad(loss_on)(params, batch)
             if self.pp_axis is not None and mesh.shape[self.pp_axis] > 1:
                 # replicated-leaf grads are PARTIAL per pipeline stage: the
                 # bucket allreduce spans pp, so prescaling by pp_size turns
@@ -710,6 +786,7 @@ class BaguaTrainer:
             self._phase,
             self.algorithm.hierarchical,
             type(self.algorithm).__name__,
+            self.algorithm.compile_key(),
         )
         if key not in self._step_cache:
             logger.info("bagua_tpu: compiling train step (phase=%s, %d buckets)",
@@ -739,6 +816,11 @@ class BaguaTrainer:
             and self._step_counter % 100 == 0
         ):
             self._autotune_step(state)
+            if self._pending_state_migration is not None:
+                # a family switch crossed the optimizer-ownership boundary:
+                # convert the opt-state layout before dispatching the step
+                state = self._pending_state_migration(state)
+                self._pending_state_migration = None
         if (
             self.autotune
             and not self._autotune_completed
@@ -812,6 +894,20 @@ class BaguaTrainer:
             (not algo.replicated_params) or expert is not None
         ) and not algo.sharded_opt_state
 
+        zero_flat = self._zero_flat
+        template = self._param_template
+        plan = self._plan
+
+        if zero_flat:
+            from ..tensor import tree_from_named
+
+            def loss_on(zp, b):
+                named = plan.unflatten_to_named(zp["flats"])
+                named.update(zp["local"])
+                return self.loss_fn(tree_from_named(template, named), b)
+        else:
+            loss_on = self.loss_fn
+
         def per_shard(state: TrainState, batch):
             params = state.params
             if stacked:
@@ -828,10 +924,10 @@ class BaguaTrainer:
                     batch,
                 )
                 loss = jnp.mean(jax.lax.map(
-                    lambda mb: self.loss_fn(params, mb), microbatches
+                    lambda mb: loss_on(params, mb), microbatches
                 ))
             else:
-                loss = self.loss_fn(params, batch)
+                loss = loss_on(params, batch)
             return self._comm.allreduce(loss, ReduceOp.AVG)
 
         fn = shard_map(per_shard, mesh=self.mesh,
@@ -850,7 +946,8 @@ class BaguaTrainer:
         # specs (build or fetch the compiled step first, then lift its specs)
         self._get_step_fn()
         key = (self._plan.signature(), self._phase,
-               self.algorithm.hierarchical, type(self.algorithm).__name__)
+               self.algorithm.hierarchical, type(self.algorithm).__name__,
+               self.algorithm.compile_key())
         if getattr(self, "_eval_key", None) != key:
             self._eval_fn = self._make_eval_fn(self._state_specs,
                                                self._batch_spec())
@@ -939,8 +1036,9 @@ class BaguaTrainer:
 
     def _maybe_switch_algorithm(self, recommended) -> None:
         """Swap the algorithm family if the autotuner asked for one
-        (BAGUA_AUTOTUNE_ALGORITHM=1).  Only stateless replicated families
-        are swappable — the TrainState layout must not change."""
+        (BAGUA_AUTOTUNE_ALGORITHM=1).  Stateless replicated families swap
+        freely; QAdam rides the state-migration adapter
+        (:meth:`_prepare_state_migration`)."""
         from ..algorithms import SWITCHABLE_ALGORITHMS
 
         target = recommended.algorithm
@@ -951,6 +1049,20 @@ class BaguaTrainer:
             or current not in SWITCHABLE_ALGORITHMS
             or target not in SWITCHABLE_ALGORITHMS
         ):
+            return
+        old_algorithm = self.algorithm
+        new_owns = (
+            self._user_algorithms[target].owns_optimizer
+            if target in self._user_algorithms
+            else SWITCHABLE_ALGORITHMS[target](False).owns_optimizer
+        )
+        if old_algorithm.owns_optimizer and not new_owns and self.optimizer is None:
+            # the user never supplied an optax optimizer (their family owns
+            # the update rule); there is nothing to switch back to
+            logger.info(
+                "autotune: cannot switch %s -> %s without a trainer optimizer",
+                current, target,
+            )
             return
         logger.info("autotune: switching algorithm %s -> %s", current, target)
         if target in self._user_algorithms:
@@ -963,12 +1075,68 @@ class BaguaTrainer:
             self.algorithm = SWITCHABLE_ALGORITHMS[target](
                 bool(recommended.is_hierarchical_reduce)
             )
+        self._prepare_state_migration(old_algorithm, self.algorithm)
         if not recommended.buckets:
             # rebuild the plan under the new family's alignment (ByteGrad
             # pads buckets to the world size); skipped when the caller is
             # about to apply the recommendation's own buckets anyway
             self.rebucket([[t.declaration() for t in b.tensors]
                            for b in self._plan.buckets])
+
+    def _prepare_state_migration(self, old, new) -> None:
+        """Queue an opt-state layout migration for the next ``train_step``
+        when a family switch crosses the trainer-optimizer / owned-optimizer
+        boundary (allreduce|bytegrad <-> qadam).
+
+        To QAdam: its momenta are param-shaped, so they are adopted from an
+        adam-family optax state when one is found (``mu``/``nu``), else start
+        at zeros; either way QAdam's own warmup contract is respected by
+        re-anchoring ``warmup_steps`` at the switch step (q_adam.py:113-145 —
+        the second moment must build in full precision before the compressed
+        phase freezes it).  The displaced optax state is stashed and restored
+        on the way back (slightly stale momentum beats a cold restart)."""
+        if old.owns_optimizer == new.owns_optimizer:
+            return
+        from ..algorithms.q_adam import QAdamAlgorithm, QAdamOptState
+
+        if new.owns_optimizer:
+            assert isinstance(new, QAdamAlgorithm), type(new)
+            # re-anchor warmup at the switch point (configured warmup counts
+            # from here, not from training start).  The RELATIVE warmup is
+            # remembered on first migration so repeated round trips through
+            # qadam don't compound the absolute anchor.
+            if not hasattr(new, "_base_warmup"):
+                new._base_warmup = new.warmup_steps
+            new._compressed = False
+            new.warmup_steps = self._step_counter + new._base_warmup
+
+            def to_owned(state):
+                # stash a COPY: the adopted moments alias the live buffers,
+                # which the next (donating) train step deletes
+                self._stashed_opt_state = jax.tree.map(
+                    jnp.copy, state.opt_state
+                )
+                moments = _find_adam_moments(state.opt_state)
+                if moments is None:
+                    zeros = jax.tree.map(jnp.zeros_like, state.params)
+                    moments = (zeros, jax.tree.map(jnp.zeros_like, state.params))
+                return state._replace(
+                    opt_state=QAdamOptState(exp_avg=moments[0],
+                                            exp_avg_sq=moments[1])
+                )
+
+            self._pending_state_migration = to_owned
+        else:
+
+            def from_owned(state):
+                stashed, self._stashed_opt_state = self._stashed_opt_state, None
+                if stashed is not None:
+                    return state._replace(opt_state=stashed)
+                return state._replace(
+                    opt_state=jax.jit(self.optimizer.init)(state.params)
+                )
+
+            self._pending_state_migration = from_owned
 
     def _autotune_step(self, state):
         from ..communication import get_hyperparameters_service_client
@@ -1068,6 +1236,27 @@ class BaguaTrainer:
         """Return params in user shape (for eval/checkpoint): rank 0's copy
         for replicated/gossip state; global ``[n_experts, ...]`` expert leaves
         re-assembled from their ep shards."""
+        if self._zero_flat:
+            # flat-resident ZeRO: materialize the leaf pytree lazily (this
+            # is the ONLY place the unflatten happens off the hot path —
+            # eval/checkpoint/user inspection).  The jitted unflatten is
+            # cached per bucket plan so periodic checkpoint/eval calls
+            # don't retrace it every time.
+            cache_key = self._plan.signature()
+            cached = getattr(self, "_unflatten_cache", None)
+            if cached is None or cached[0] != cache_key:
+                from ..tensor import tree_from_named
+
+                plan, template = self._plan, self._param_template
+
+                def unflatten(zp):
+                    named = plan.unflatten_to_named(zp["flats"])
+                    named.update(zp["local"])
+                    return tree_from_named(template, named)
+
+                cached = (cache_key, jax.jit(unflatten))
+                self._unflatten_cache = cached
+            return cached[1](state.params)
         if self.expert_axis is None or self.algorithm.sharded_opt_state:
             # ZeRO keeps expert leaves as global [n_experts, ...] arrays
             # (sharded in place), so no re-assembly is needed

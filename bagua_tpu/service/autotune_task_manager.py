@@ -29,11 +29,13 @@ logger = logging.getLogger(__name__)
 MIN_BUCKET_SIZE_EXP = 10   # 1 KiB
 MAX_BUCKET_SIZE_EXP = 31   # 2 GiB   (reference: 2^10 .. 2^31)
 
-# Only families the trainer can hot-swap mid-training (stateless, replicated,
-# trainer-owned optimizer — see algorithms.SWITCHABLE_ALGORITHMS).  Gossip and
-# owner families change the TrainState layout, so recommending them would
-# record scores against configs the trainer silently cannot apply.
-ALGORITHM_FAMILIES = ["gradient_allreduce", "bytegrad"]
+# Only families the trainer can hot-swap mid-training — the stateless
+# replicated pair plus QAdam, whose param-shaped momenta ride the trainer's
+# state-migration adapter (see algorithms.SWITCHABLE_ALGORITHMS).  Gossip and
+# sharded-opt-state families change the TrainState layout irreversibly, so
+# recommending them would record scores against configs the trainer silently
+# cannot apply.
+ALGORITHM_FAMILIES = ["gradient_allreduce", "bytegrad", "qadam"]
 
 
 class AutotuneTaskManager:

@@ -127,7 +127,16 @@ class HangWatchdog:
                 continue
             with self.watch(label):
                 try:
-                    np.asarray(array)  # host readback: the reliable fence
+                    # host readback: the reliable fence.  Multi-process
+                    # global arrays can't be fetched whole — their LOCAL
+                    # shard is the per-process fence instead.
+                    if (
+                        hasattr(array, "is_fully_addressable")
+                        and not array.is_fully_addressable
+                    ):
+                        np.asarray(array.addressable_shards[0].data)
+                    else:
+                        np.asarray(array)
                 except Exception as e:
                     # runtime errors surface on the main thread's own use
                     # of the result; the watchdog only cares about hangs.

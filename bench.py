@@ -169,7 +169,10 @@ def _perf_fields(trainer, state, data, dt, timed) -> dict:
             # "bytes accessed" counts every buffer touch, including those
             # served from VMEM, so it upper-bounds true HBM traffic and
             # hbm_util can read slightly above 1.0 — it is a roofline
-            # indicator (≈1 → bandwidth-bound), not a literal utilisation
+            # indicator (≈1 → bandwidth-bound), not a literal utilisation.
+            # The PROFILER-measured fields below (hbm_gbps_measured) are the
+            # ground truth: per-op memory_access_breakdown separates HBM
+            # from on-chip VMEM/CMEM traffic.
             fields["hbm_util"] = round(gbps / peak_bw, 3)
             if gbps > peak_bw * 1.5:
                 raise BenchSanityError(
@@ -179,13 +182,56 @@ def _perf_fields(trainer, state, data, dt, timed) -> dict:
     return fields
 
 
-def bench_family(family: str, algo_factory, mesh, n_dev: int) -> dict:
+def _measured_memory_fields(trainer, state, data) -> dict:
+    """Profiler-grounded HBM bandwidth (VERDICT r3 #3): trace a few steps
+    and parse per-op memory_access_breakdown.  TPU only; {} elsewhere."""
+    if jax.devices()[0].platform != "tpu":
+        return {}
+    from bagua_tpu.profiling import trace_memory_traffic
+
+    holder = {"state": state, "loss": None}
+
+    def run_step():  # enqueue only: per-step fencing would serialize dispatch
+        holder["state"], holder["loss"] = trainer.train_step(
+            holder["state"], data
+        )
+
+    fields = trace_memory_traffic(
+        run_step, steps=5, finalize=lambda: float(holder["loss"])
+    )
+    if not fields:
+        return {}
+    kind = jax.devices()[0].device_kind
+    peak_bw = PEAK_HBM_GBPS.get(kind)
+    out = {
+        "hbm_gbps_measured": fields["hbm_gbps_measured"],
+        "vmem_gb_per_step": fields["vmem_gb_per_step"],
+        "hbm_gb_per_step": fields["hbm_gb_per_step"],
+    }
+    if peak_bw:
+        out["hbm_util_measured"] = round(
+            fields["hbm_gbps_measured"] / peak_bw, 3
+        )
+        if fields["hbm_gbps_measured"] > peak_bw:
+            raise BenchSanityError(
+                f"profiler-measured {fields['hbm_gbps_measured']} GB/s HBM "
+                f"exceeds the {peak_bw:.0f} GB/s {kind} peak"
+            )
+    return out
+
+
+def bench_family(family: str, algo_factory, mesh, n_dev: int,
+                 batch_per_device: int = BATCH_PER_DEVICE,
+                 image_dtype=jnp.float32) -> dict:
     from bagua_tpu.core.backend import BaguaTrainer
     from bagua_tpu.models.resnet import ResNet50, classification_loss_fn
 
     model = ResNet50(num_classes=1000)
-    batch = BATCH_PER_DEVICE * n_dev
-    images = jnp.zeros((batch, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
+    batch = batch_per_device * n_dev
+    # bf16 image input halves the input pipeline's HBM traffic (the first
+    # conv reads the batch at full resolution); the model computes in bf16
+    # internally either way
+    images = jnp.zeros((batch, IMAGE_SIZE, IMAGE_SIZE, 3), image_dtype)
     labels = jnp.zeros((batch,), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), images[:2], train=True)
 
@@ -202,18 +248,28 @@ def bench_family(family: str, algo_factory, mesh, n_dev: int) -> dict:
     try:
         dt, state, _ = _time_steps(trainer, state, data)
         perf = _perf_fields(trainer, state, data, dt, TIMED_STEPS)
+        try:
+            perf.update(_measured_memory_fields(trainer, state, data))
+        except BenchSanityError:
+            raise
+        except Exception as e:  # noqa: BLE001 - tracing must not lose a record
+            print(f"# measured-memory trace failed: {e}", flush=True)
     finally:
         if hasattr(algo, "abort"):  # stop the async averaging thread even
             algo.abort()           # when timing/sanity raises mid-record
 
     per_device = TIMED_STEPS * batch / dt / n_dev
     floor = FAMILY_FLOORS[family]
+    suffix = "" if image_dtype == jnp.float32 else "_bf16in"
+    if batch_per_device != BATCH_PER_DEVICE:
+        suffix += f"_b{batch_per_device}"
     return {
-        "metric": f"resnet50_{family}_imgs_per_sec_per_chip",
+        "metric": f"resnet50_{family}_imgs_per_sec_per_chip{suffix}",
         "value": round(per_device, 1),
         "unit": "img/s/chip",
         "vs_baseline": round(per_device / floor, 3),
-        "batch_per_chip": BATCH_PER_DEVICE,
+        "batch_per_chip": batch_per_device,
+        "image_dtype": jnp.dtype(image_dtype).name,
         **perf,
     }
 
@@ -523,6 +579,9 @@ def main():
                     help="run every algorithm family + MoE + BERT")
     ap.add_argument("--goldens", action="store_true",
                     help="print deterministic loss goldens and exit")
+    ap.add_argument("--resnet-sweep", action="store_true",
+                    help="sweep ResNet input dtype (f32/bf16) x batch "
+                         "(128/256), writing BENCH_RESNET_SWEEP.json")
     args = ap.parse_args()
 
     if args.goldens:
@@ -534,6 +593,23 @@ def main():
     devices = jax.devices()
     n_dev = len(devices)
     mesh = build_mesh({"dp": n_dev}, devices)
+
+    if args.resnet_sweep:
+        records = []
+        factory = _algorithms()["gradient_allreduce"]
+        for dtype in (jnp.float32, jnp.bfloat16):
+            for bpd in (128, 256):
+                try:
+                    records.append(_emit(bench_family(
+                        "gradient_allreduce", factory, mesh, n_dev,
+                        batch_per_device=bpd, image_dtype=dtype,
+                    )))
+                except Exception as e:  # noqa: BLE001 - record and continue
+                    print(f"# sweep dtype={dtype} b={bpd} failed: {e}",
+                          flush=True)
+        with open("BENCH_RESNET_SWEEP.json", "w") as f:
+            json.dump(records, f, indent=1)
+        return
 
     if args.suite:
         records = []

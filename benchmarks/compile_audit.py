@@ -69,6 +69,60 @@ def loss_fn(p, b):
     ).mean()
 
 
+def audit_flagship(n_devices):
+    """Compile the FLAGSHIP (transformer LM) train step on a dp x tp mesh —
+    the shape the driver's dryrun_multichip exercises — and record its
+    compile time at this mesh size."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+
+    import bagua_tpu
+    from bagua_tpu.algorithms import GradientAllReduceAlgorithm
+    from bagua_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss_fn,
+    )
+
+    tp = 2
+    dp = n_devices // tp
+    devs = np.array(jax.devices()[:n_devices]).reshape(dp, tp)
+    mesh = Mesh(devs, ("dp", "tp"))
+    kw = dict(vocab_size=512, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+              max_seq_len=32)
+    cfg = TransformerConfig(tp_axis="tp", tp_size=tp, **kw)
+    model = TransformerLM(cfg)
+    tokens = jnp.zeros((dp * 2, cfg.max_seq_len + 1), jnp.int32)
+    # init with GLOBAL shapes (plain config); the trainer shards tp leaves
+    params = TransformerLM(TransformerConfig(**kw)).init(
+        jax.random.PRNGKey(0), tokens[:1, :-1]
+    )["params"]
+    trainer = bagua_tpu.BaguaTrainer(
+        lm_loss_fn(model), optax.sgd(0.1), GradientAllReduceAlgorithm(),
+        mesh=mesh, dp_axes=("dp",), tp_axis="tp", bucket_bytes=4096,
+    )
+    t0 = _time.time()
+    state = trainer.init(params)
+    batch = trainer.shard_batch({"tokens": tokens})
+    fn = trainer._get_step_fn()
+    lowered = fn.lower(state, batch)
+    trace_s = _time.time() - t0
+    t1 = _time.time()
+    lowered.compile()
+    rec = {
+        "family": "flagship_transformer_dp_tp",
+        "n_devices": n_devices,
+        "trace_s": round(trace_s, 3),
+        "compile_s": round(_time.time() - t1, 3),
+        "stablehlo_bytes": len(lowered.as_text()),
+    }
+    print(json.dumps(rec), flush=True)
+    return [rec]
+
+
 def audit(n_devices, families):
     import jax
     import jax.numpy as jnp
@@ -118,6 +172,9 @@ def main():
         "decentralized_shift_one", "low_precision_decentralized", "zero",
         "async",
     ])
+    ap.add_argument("--flagship", action="store_true",
+                    help="also compile the transformer LM step on a dp x tp "
+                         "mesh at each device count")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -130,6 +187,8 @@ def main():
 
             cmd = [sys.executable, os.path.abspath(__file__),
                    "--devices", str(n), "--families", *args.families]
+            if args.flagship:
+                cmd.append("--flagship")
             out = subprocess.run(cmd, capture_output=True, text=True,
                                  timeout=1200, env=dict(os.environ))
             if out.returncode != 0:
@@ -156,6 +215,8 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
     records = audit(n, args.families)
+    if args.flagship:
+        records += audit_flagship(n)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(records, f, indent=1)

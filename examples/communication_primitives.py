@@ -48,11 +48,13 @@ def main():
     np.testing.assert_allclose(out, np.tile(x.sum(0), (n, 1)), rtol=1e-5)
     np.testing.assert_allclose(out, out_inplace)
 
-    # reduce (only dst holds the result)
+    # reduce (only dst holds the result; non-dst recv untouched -> recv=)
     x = rand(n, 4)
-    out = np.asarray(bagua_tpu.reduce(jnp.asarray(x), 1, ReduceOp.SUM, comm=comm))
+    recv = rand(n, 4)
+    out = np.asarray(bagua_tpu.reduce(
+        jnp.asarray(x), 1, ReduceOp.SUM, comm=comm, recv=jnp.asarray(recv)))
     np.testing.assert_allclose(out[1], x.sum(0), rtol=1e-5)
-    np.testing.assert_allclose(out[0], x[0])
+    np.testing.assert_allclose(out[0], recv[0])
 
     # allgather
     x = rand(n, 3)
@@ -60,9 +62,10 @@ def main():
     for r in range(n):
         np.testing.assert_allclose(out[r].reshape(n, 3)[r], x[r])
 
-    # gather (dst holds everyone's slice)
+    # gather (dst holds everyone's slice; non-dst untouched -> zeros)
     out = np.asarray(bagua_tpu.gather(jnp.asarray(x), 0, comm=comm))
     np.testing.assert_allclose(out[0].reshape(n, 3), x)
+    np.testing.assert_allclose(out[1], np.zeros_like(out[1]))
 
     # scatter (rank r gets chunk r of src's buffer)
     x = rand(n, n * 2)

@@ -53,6 +53,65 @@ class SyntheticImageNet:
         return img, int(i % self.classes)
 
 
+class NpyDirImageNet:
+    """Real images from ``{data_dir}/{class_name}/*.npy`` — each file one
+    HWC float/uint8 array (the reference example reads an ImageFolder tree,
+    /root/reference/examples/imagenet/main.py; zero-egress environments
+    pre-decode to .npy).  Labels follow sorted class-dir order.  Arrays are
+    center-cropped/padded to ``size`` and normalized to zero mean."""
+
+    def __init__(self, data_dir, size):
+        import os
+
+        self.size = size
+        self.items = []
+        classes = sorted(
+            d for d in os.listdir(data_dir)
+            if os.path.isdir(os.path.join(data_dir, d))
+        )
+        self.classes = len(classes)
+        for label, cls in enumerate(classes):
+            cdir = os.path.join(data_dir, cls)
+            for f in sorted(os.listdir(cdir)):
+                if f.endswith(".npy"):
+                    self.items.append((os.path.join(cdir, f), label))
+        if not self.items:
+            raise FileNotFoundError(f"no {{class}}/*.npy under {data_dir}")
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, i):
+        path, label = self.items[i]
+        img = np.load(path).astype(np.float32)
+        if img.ndim == 3 and img.shape[-1] == 1:
+            img = img[..., 0]  # (H, W, 1) grayscale
+        if img.ndim == 2:
+            img = np.stack([img] * 3, -1)
+        if img.max() > 2.0:  # uint8-range input
+            img = img / 127.5 - 1.0
+        s = self.size
+        h, w = img.shape[:2]
+        if h < s or w < s:
+            img = np.pad(img, ((0, max(0, s - h)), (0, max(0, s - w)), (0, 0)))
+            h, w = img.shape[:2]
+        top, left = (h - s) // 2, (w - s) // 2
+        return img[top:top + s, left:left + s, :3], label
+
+
+class _Subset:
+    """Index-remapped view (train split) over a dataset/cache."""
+
+    def __init__(self, base, idx):
+        self.base, self.idx = base, idx
+
+    def __len__(self):
+        return len(self.idx)
+
+    def __getitem__(self, i):
+        return self.base[self.idx[i]]
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
@@ -61,14 +120,24 @@ def main():
                     choices=["gradient_allreduce", "bytegrad"])
     ap.add_argument("--tiny", action="store_true",
                     help="small ResNet + 64px images for CPU smoke runs")
-    ap.add_argument("--data-dir", type=str, default=None)
+    ap.add_argument("--data-dir", type=str, default=None,
+                    help="directory of {class}/{img}.npy real images")
+    ap.add_argument("--epochs", type=int, default=1,
+                    help="passes over the real dataset (with --data-dir)")
+    ap.add_argument("--eval-frac", type=float, default=0.2,
+                    help="held-out fraction for the accuracy gate")
+    ap.add_argument("--gate-accuracy", type=float, default=None,
+                    help="fail unless held-out accuracy reaches this")
+    ap.add_argument("--lr", type=float, default=0.05)
     args = ap.parse_args()
 
     mesh = bagua_tpu.init_process_group()
     n_dev = len(jax.devices())
     batch = args.batch_per_device * n_dev
     size = 64 if args.tiny else 224
-    classes = 16 if args.tiny else 1000
+
+    real = NpyDirImageNet(args.data_dir, size) if args.data_dir else None
+    classes = real.classes if real else (16 if args.tiny else 1000)
 
     norm_cls = partial(SyncBatchNorm, axis_name=mesh.axis_names)
     if args.tiny:
@@ -77,11 +146,21 @@ def main():
     else:
         model = ResNet50(num_classes=classes, norm_cls=norm_cls)
 
-    dataset = SyntheticImageNet(batch * 8, size, classes)
+    dataset = real if real else SyntheticImageNet(batch * 8, size, classes)
+    # held-out split for the accuracy gate (real data only)
+    eval_idx = []
+    train_idx = list(range(len(dataset)))
+    if real is not None and args.eval_frac > 0:
+        rng = np.random.default_rng(0)
+        perm = rng.permutation(len(dataset))
+        n_eval = max(1, int(len(dataset) * args.eval_frac))
+        eval_idx, train_idx = list(perm[:n_eval]), list(perm[n_eval:])
+
     cached = CachedDataset(dataset, backend="tcp", dataset_name="imagenet",
                            writer_buffer_size=8, num_shards=2)
     sampler = LoadBalancingDistributedSampler(
-        cached, complexity_fn=lambda s: int(abs(s[0]).sum() * 100),
+        _Subset(cached, train_idx),
+        complexity_fn=lambda s: int(abs(s[0]).sum() * 100),
         num_replicas=1, rank=0,  # one JAX process drives all local chips
     )
 
@@ -92,16 +171,19 @@ def main():
             else GradientAllReduceAlgorithm())
     trainer = bagua_tpu.BaguaTrainer(
         classification_loss_fn(model, batch_stats=variables["batch_stats"]),
-        fuse_optimizer(optax.sgd(0.05, momentum=0.9)),
+        fuse_optimizer(optax.sgd(args.lr, momentum=0.9)),
         algo, mesh=mesh,
     )
     state = trainer.init(variables["params"])
 
-    indices = list(sampler)
+    indices = list(sampler)  # positions into train_idx
+    steps = (
+        args.epochs * max(1, len(indices) // batch) if real else args.steps
+    )
     losses = []
-    for step in range(args.steps):
+    for step in range(steps):
         sel = [indices[(step * batch + j) % len(indices)] for j in range(batch)]
-        samples = [cached[i] for i in sel]
+        samples = [cached[train_idx[i]] if real else cached[i] for i in sel]
         data = trainer.shard_batch({
             "images": np.stack([s[0] for s in samples]),
             "labels": np.array([s[1] for s in samples], np.int32),
@@ -113,6 +195,31 @@ def main():
     cached.cache_loader.store.shutdown()
     print(f"final_loss {losses[-1]:.6f} cache_entries {n_cached}")
     assert np.isfinite(losses[-1])
+
+    if eval_idx:
+        # held-out accuracy with batch-mode normalization (the trainer keeps
+        # running stats frozen; see classification_loss_fn)
+        apply = jax.jit(lambda p, x: model.apply(
+            {"params": p, "batch_stats": variables["batch_stats"]},
+            x, train=True, mutable=["batch_stats"],
+        )[0])
+        correct = total = 0
+        eb = min(batch, len(eval_idx))
+        for i0 in range(0, len(eval_idx) - eb + 1, eb):
+            sel = eval_idx[i0:i0 + eb]
+            samples = [dataset[i] for i in sel]
+            logits = apply(state.params,
+                           jnp.asarray(np.stack([s[0] for s in samples])))
+            pred = np.argmax(np.asarray(logits), -1)
+            labels = np.array([s[1] for s in samples])
+            correct += int((pred == labels).sum())
+            total += len(sel)
+        acc = correct / max(1, total)
+        print(f"eval_accuracy {acc:.4f} ({total} held-out samples)")
+        if args.gate_accuracy is not None:
+            assert acc >= args.gate_accuracy, (
+                f"held-out accuracy {acc:.3f} below gate {args.gate_accuracy}"
+            )
 
 
 if __name__ == "__main__":

@@ -71,10 +71,16 @@ def main():
     model = SquadModel(cfg)
 
     if args.dataset:
+        # real tokenized SQuAD rows: cycle through the WHOLE file batch by
+        # batch (the reference fine-tunes over the real dataset, not one
+        # memorized batch; .buildkite benchmark_master.sh:83-153)
         data = np.load(args.dataset)
-        ids = data["input_ids"][:batch].astype(np.int32)
-        starts = data["start_positions"][:batch].astype(np.int32)
-        ends = data["end_positions"][:batch].astype(np.int32)
+        n_rows = (len(data["input_ids"]) // batch) * batch
+        if n_rows == 0:
+            raise SystemExit(f"dataset has fewer than {batch} rows")
+        ids = data["input_ids"][:n_rows].astype(np.int32)
+        starts = data["start_positions"][:n_rows].astype(np.int32)
+        ends = data["end_positions"][:n_rows].astype(np.int32)
     else:
         rng = np.random.default_rng(0)
         ids = rng.integers(0, cfg.vocab_size, (batch, args.seq)).astype(np.int32)
@@ -93,14 +99,24 @@ def main():
     algo, tx = make_algorithm(args.algorithm, args.lr)
     trainer = bagua_tpu.BaguaTrainer(loss_fn, tx, algo)
     state = trainer.init(params)
-    data = trainer.shard_batch({"ids": ids, "start": starts, "end": ends})
+    n_batches = max(1, len(ids) // batch)
+    shards = {}  # shard lazily: only batches --steps actually touches
+
+    def shard(k):
+        if k not in shards:
+            shards[k] = trainer.shard_batch({
+                "ids": ids[k * batch:(k + 1) * batch],
+                "start": starts[k * batch:(k + 1) * batch],
+                "end": ends[k * batch:(k + 1) * batch],
+            })
+        return shards[k]
 
     import time
 
     losses = []
     t0 = None
     for step in range(args.steps):
-        state, loss = trainer.train_step(state, data)
+        state, loss = trainer.train_step(state, shard(step % n_batches))
         losses.append(float(loss))
         if step == 0:
             jax.block_until_ready(loss)

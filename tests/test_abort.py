@@ -139,6 +139,36 @@ def test_user_abort_stops_async_loop():
         algo.abort()
 
 
+def test_eager_collectives_are_watchdog_fenced(monkeypatch):
+    """Standalone eager primitives must route through the global watchdog
+    (reference: the comm monitor covers ALL scheduled ops, lib.rs:255-265)
+    and fail fast once the abort flag is up — not only trainer steps."""
+    import numpy as np
+
+    import bagua_tpu.watchdog as wdmod
+
+    wd = HangWatchdog(timeout_s=300, action="log")
+    seen = []
+    orig = wd.watch_result
+    monkeypatch.setattr(
+        wd, "watch_result",
+        lambda arr, label="": (seen.append(label), orig(arr, label)),
+    )
+    monkeypatch.setattr(wdmod, "_GLOBAL", wd)
+    monkeypatch.delenv("BAGUA_COMM_TIMEOUT_S", raising=False)
+    try:
+        out = bagua_tpu.allreduce(np.ones((N_DEVICES, 4), np.float32))
+        jax.block_until_ready(out)
+        assert any(str(l).startswith("eager:") for l in seen), seen
+        # once aborted (watchdog or user), eager dispatches fail fast too
+        bagua_tpu.abort("test")
+        with pytest.raises(bagua_tpu.BaguaAborted):
+            bagua_tpu.allreduce(np.ones((N_DEVICES, 4), np.float32))
+        bagua_tpu.reset_abort()
+    finally:
+        wd.stop()
+
+
 def test_clean_interpreter_exit_with_watchdog(tmp_path):
     """A script using the default-on watchdog must exit 0: the waiter
     thread is stopped via atexit BEFORE interpreter teardown — a daemon

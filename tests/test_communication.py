@@ -86,7 +86,55 @@ def test_reduce_to_dst(rng):
     out = bagua_tpu.reduce(x, dst=2, op=ReduceOp.SUM)
     xs = np.asarray(x)
     np.testing.assert_allclose(np.asarray(out)[2], xs.sum(axis=0), rtol=1e-5)
-    np.testing.assert_allclose(np.asarray(out)[0], xs[0], rtol=1e-6)
+    # non-dst slices: the reference collective does not write their recv
+    # buffers (communication.py:331-375) — zeros without an explicit recv
+    np.testing.assert_allclose(np.asarray(out)[0], np.zeros_like(xs[0]))
+
+
+def test_reduce_non_dst_reproduces_recv(rng):
+    """Pinned reference semantics: non-dst recv buffers are untouched, so
+    passing recv= reproduces its values on non-dst ranks."""
+    x = _rank_data(rng, (N, 5))
+    recv = _rank_data(rng, (N, 5))
+    out = bagua_tpu.reduce(x, dst=1, op=ReduceOp.SUM, recv=recv)
+    xs, rs = np.asarray(x), np.asarray(recv)
+    np.testing.assert_allclose(np.asarray(out)[1], xs.sum(axis=0), rtol=1e-5)
+    for r in range(N):
+        if r != 1:
+            np.testing.assert_allclose(np.asarray(out)[r], rs[r], rtol=1e-6)
+
+
+def test_gather_non_dst_reproduces_recv(rng):
+    """Pinned reference semantics for gather (communication.py:576-614):
+    only dst's recv holds the gathered data; others' stay untouched."""
+    x = _rank_data(rng, (N, 3))
+    xs = np.asarray(x)
+    out = np.asarray(bagua_tpu.gather(x, dst=2))
+    np.testing.assert_allclose(out[2].reshape(N, 3), xs, rtol=1e-6)
+    for r in range(N):
+        if r != 2:
+            np.testing.assert_allclose(out[r], np.zeros_like(out[r]))
+    recv = _rank_data(rng, (N, N * 3))
+    out2 = np.asarray(bagua_tpu.gather(x, dst=2, recv=recv))
+    np.testing.assert_allclose(out2[2].reshape(N, 3), xs, rtol=1e-6)
+    for r in range(N):
+        if r != 2:
+            np.testing.assert_allclose(out2[r], np.asarray(recv)[r], rtol=1e-6)
+
+
+def test_scatter_reads_only_src(rng):
+    """Pinned reference semantics (communication.py:649-687): only src's
+    send buffer is read; every rank receives its chunk of it."""
+    x = _rank_data(rng, (N, N * 2))
+    out = np.asarray(bagua_tpu.scatter(x, 1))
+    src = np.asarray(x)[1].reshape(N, 2)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], src[r], rtol=1e-6)
+    # perturbing a NON-src rank's send slice must not change any output
+    x2 = np.array(np.asarray(x))
+    x2[3] += 100.0
+    out2 = np.asarray(bagua_tpu.scatter(x2, 1))
+    np.testing.assert_allclose(out2, out, rtol=1e-6)
 
 
 def test_send_recv_ring(rng):

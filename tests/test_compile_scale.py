@@ -88,6 +88,6 @@ def test_artifact_exists_and_has_all_families():
     fams = {(r["family"], r["n_devices"]) for r in records}
     for fam in ("gradient_allreduce", "bytegrad", "qadam", "decentralized",
                 "decentralized_shift_one", "low_precision_decentralized",
-                "zero", "async"):
+                "zero", "async", "flagship_transformer_dp_tp"):
         assert (fam, 32) in fams and (fam, 64) in fams, fam
     assert all(r["compile_s"] < 60 for r in records), records

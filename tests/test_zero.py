@@ -66,7 +66,9 @@ def test_matches_replicated_adam():
     )
     st_plain, _ = _train(plain, params, xs, ys)
 
-    for a, b in zip(jax.tree.leaves(st_zero.params), jax.tree.leaves(st_plain.params)):
+    # flat-resident layout: leaf views materialize via unstack_params
+    z_leaves = jax.tree.leaves(zero.unstack_params(st_zero))
+    for a, b in zip(z_leaves, jax.tree.leaves(st_plain.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
 
 
@@ -122,7 +124,8 @@ def test_clip_global_norm_matches_optax():
     for s in range(xs.shape[0]):
         gp, gopt = g_step(gp, gopt, {"x": xs[s], "y": ys[s]})
 
-    for a, b in zip(jax.tree.leaves(st_zero.params), jax.tree.leaves(gp)):
+    z_leaves = jax.tree.leaves(zero.unstack_params(st_zero))
+    for a, b in zip(z_leaves, jax.tree.leaves(gp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
 
 
