@@ -617,20 +617,14 @@ class BaguaTrainer:
             a for a in dp + ((self.seq_axis,) if self.seq_axis else ())
             if mesh.shape[a] > 1
         )
-        zero_flat = self._zero_flat
-        template = self._param_template
-        plan = self._plan
-
-        if zero_flat:
-            from ..tensor import tree_from_named
+        if self._zero_flat:
+            leaf_view = self._flat_leaf_view
 
             def loss_on(zp, b):
                 # flat-resident params: materialize the leaf view (slicing —
                 # XLA fuses it); autodiff w.r.t. zp scatters grads straight
                 # back into bucket-flat layout
-                named = plan.unflatten_to_named(zp["flats"])
-                named.update(zp["local"])
-                return self.loss_fn(tree_from_named(template, named), b)
+                return self.loss_fn(leaf_view(zp), b)
         else:
             loss_on = self.loss_fn
 
@@ -780,6 +774,17 @@ class BaguaTrainer:
         )
         return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
 
+    def _flat_leaf_view(self, zp):
+        """Materialize the leaf pytree from the flat-resident ZeRO layout
+        (traceable; slicing that XLA fuses).  The ONE implementation of the
+        flats->leaves contract, shared by the train step, eval step, and
+        ``unstack_params``."""
+        from ..tensor import tree_from_named
+
+        named = self._plan.unflatten_to_named(zp["flats"])
+        named.update(zp["local"])
+        return tree_from_named(self._param_template, named)
+
     def _get_step_fn(self):
         key = (
             self._plan.signature(),
@@ -894,17 +899,11 @@ class BaguaTrainer:
             (not algo.replicated_params) or expert is not None
         ) and not algo.sharded_opt_state
 
-        zero_flat = self._zero_flat
-        template = self._param_template
-        plan = self._plan
-
-        if zero_flat:
-            from ..tensor import tree_from_named
+        if self._zero_flat:
+            leaf_view = self._flat_leaf_view
 
             def loss_on(zp, b):
-                named = plan.unflatten_to_named(zp["flats"])
-                named.update(zp["local"])
-                return self.loss_fn(tree_from_named(template, named), b)
+                return self.loss_fn(leaf_view(zp), b)
         else:
             loss_on = self.loss_fn
 
@@ -1245,16 +1244,7 @@ class BaguaTrainer:
             cache_key = self._plan.signature()
             cached = getattr(self, "_unflatten_cache", None)
             if cached is None or cached[0] != cache_key:
-                from ..tensor import tree_from_named
-
-                plan, template = self._plan, self._param_template
-
-                def unflatten(zp):
-                    named = plan.unflatten_to_named(zp["flats"])
-                    named.update(zp["local"])
-                    return tree_from_named(template, named)
-
-                cached = (cache_key, jax.jit(unflatten))
+                cached = (cache_key, jax.jit(self._flat_leaf_view))
                 self._unflatten_cache = cached
             return cached[1](state.params)
         if self.expert_axis is None or self.algorithm.sharded_opt_state:

@@ -166,20 +166,27 @@ def trace_memory_traffic(run_step, steps: int = 5, log_dir=None,
 
     import jax
 
+    import shutil
+
+    owned = log_dir is None
     d = log_dir or tempfile.mkdtemp(prefix="bagua_trace_")
-    with jax.profiler.trace(d):
-        for _ in range(steps):
-            run_step()
-        if finalize is not None:
-            finalize()
-    files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
-    if not files:
-        return {}
     try:
-        return parse_xplane_memory_traffic(files[-1])
-    except Exception as e:  # pragma: no cover - proto availability varies
-        logger.info("xplane parse unavailable: %s", e)
-        return {}
+        with jax.profiler.trace(d):
+            for _ in range(steps):
+                run_step()
+            if finalize is not None:
+                finalize()
+        files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
+        if not files:
+            return {}
+        try:
+            return parse_xplane_memory_traffic(files[-1])
+        except Exception as e:  # pragma: no cover - proto availability varies
+            logger.info("xplane parse unavailable: %s", e)
+            return {}
+    finally:
+        if owned:  # don't leak tens-of-MB traces to /tmp per bench record
+            shutil.rmtree(d, ignore_errors=True)
 
 
 def parse_xplane_memory_traffic(xplane_path: str) -> dict:

@@ -394,6 +394,10 @@ def bench_bert(mesh, n_dev: int) -> dict:
     data = trainer.shard_batch({"tokens": tokens})
     dt, state, _ = _time_steps(trainer, state, data, timed=10)
     perf = _perf_fields(trainer, state, data, dt, 10)
+    try:
+        perf.update(_measured_memory_fields(trainer, state, data))
+    except Exception as e:  # noqa: BLE001 - tracing must not lose a record
+        print(f"# measured-memory trace failed: {e}", flush=True)
     seq_per_sec = 10 * batch / dt
     return {
         "metric": "bert_large_bytegrad_seqs_per_sec",
@@ -429,6 +433,10 @@ def bench_vgg16(mesh, n_dev: int) -> dict:
     data = trainer.shard_batch({"images": images, "labels": labels})
     dt, state, _ = _time_steps(trainer, state, data)
     perf = _perf_fields(trainer, state, data, dt, TIMED_STEPS)
+    try:
+        perf.update(_measured_memory_fields(trainer, state, data))
+    except Exception as e:  # noqa: BLE001 - tracing must not lose a record
+        print(f"# measured-memory trace failed: {e}", flush=True)
     per_device = TIMED_STEPS * batch / dt / n_dev
     return {
         "metric": "vgg16_gradient_allreduce_imgs_per_sec_per_chip",

@@ -205,8 +205,8 @@ def main():
         )[0])
         correct = total = 0
         eb = min(batch, len(eval_idx))
-        for i0 in range(0, len(eval_idx) - eb + 1, eb):
-            sel = eval_idx[i0:i0 + eb]
+        for i0 in range(0, len(eval_idx), eb):
+            sel = eval_idx[i0:i0 + eb]  # tail partial batch included
             samples = [dataset[i] for i in sel]
             logits = apply(state.params,
                            jnp.asarray(np.stack([s[0] for s in samples])))

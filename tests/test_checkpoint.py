@@ -253,3 +253,24 @@ def test_save_restore_pp_sharded_state(tmp_path):
         resumed.append(float(loss))
     np.testing.assert_allclose(resumed, ref[2:], rtol=1e-6)
     mgr.close()
+
+
+def test_flush_all_checkpoints_drains_async_saves(tmp_path):
+    """The watchdog's pre-exit flush (os._exit skips atexit) must make
+    queued async saves durable — bounded, so a wedged flush can't block the
+    exit path (ADVICE r3: watchdog default-on exit loses async saves)."""
+    import jax.numpy as jnp
+
+    from bagua_tpu.checkpoint import BaguaCheckpointManager, flush_all_checkpoints
+
+    mgr = BaguaCheckpointManager(str(tmp_path / "ck"), async_save=True)
+    state = {"w": jnp.arange(8.0)}
+    assert mgr.save(0, state)
+    flush_all_checkpoints(timeout_s=30.0)
+    assert mgr.latest_step() == 0
+    step, restored = mgr.restore({"w": jnp.zeros(8)})
+    assert step == 0
+    import numpy as np
+
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8.0))
+    mgr.close()

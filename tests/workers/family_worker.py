@@ -129,6 +129,27 @@ def main():
     # window means: single-batch losses are noisy for gossip algorithms
     assert np.mean(losses[-4:]) < np.mean(losses[:4]), losses
 
+    if family == "gradient_allreduce":
+        # eager primitive with MULTIPLE owned ranks per process (each process
+        # drives 2 of the 4 mesh devices): the per-process call shape is one
+        # row per OWNED rank — the validation the single-device bring-up
+        # test cannot exercise (ADVICE r3 communication.py:375)
+        owned = n_dev // world
+        contrib = np.stack(
+            [np.full((4,), rank * owned + j + 1.0, np.float32)
+             for j in range(owned)]
+        )
+        reduced = bagua_tpu.allreduce(contrib, op=bagua_tpu.ReduceOp.SUM)
+        expect = sum(range(1, n_dev + 1))
+        got = np.asarray(reduced.addressable_shards[0].data)
+        assert np.allclose(got, expect), (got, expect)
+        # wrong row count must be rejected with the clear error
+        try:
+            bagua_tpu.allreduce(np.zeros((owned + 1, 4), np.float32))
+            raise SystemExit("row validation did not fire")
+        except ValueError as e:
+            assert "owns" in str(e), e
+
     out = os.environ["BAGUA_TEST_OUT"]
     with open(os.path.join(out, f"{family}_rank{rank}.txt"), "w") as f:
         f.write(repr([round(v, 6) for v in losses]))
