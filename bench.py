@@ -280,7 +280,7 @@ def bench_family(family: str, algo_factory, mesh, n_dev: int,
 
 
 def _bench_moe_impl(mesh, n_dev: int, dropless: bool, seq: int = 512,
-                    timed: int = 10) -> float:
+                    timed: int = 10, measure: bool = False):
     from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm
     from bagua_tpu.core.backend import BaguaTrainer
     from bagua_tpu.model_parallel.moe import MoEMLP, moe_lm_loss_fn
@@ -315,19 +315,30 @@ def _bench_moe_impl(mesh, n_dev: int, dropless: bool, seq: int = 512,
         if ep > 1 else params
     )
     data = trainer.shard_batch({"tokens": tokens})
-    dt, _, _ = _time_steps(trainer, state, data, timed=timed)
-    return timed * batch * cfg.max_seq_len / dt
+    dt, state, _ = _time_steps(trainer, state, data, timed=timed)
+    tps = timed * batch * cfg.max_seq_len / dt
+    measured = {}
+    if measure:  # only the run whose fields land in a record pays the trace
+        try:
+            measured = _measured_memory_fields(trainer, state, data)
+        except BenchSanityError:
+            raise  # impossible measured rate: re-measure, don't record
+        except Exception as e:  # noqa: BLE001
+            print(f"# measured-memory trace failed: {e}", flush=True)
+    return tps, measured
 
 
 def bench_moe(mesh, n_dev: int) -> dict:
     """Expert-parallel MoE throughput (reference MoE CI run,
     benchmark_master.sh:126-153; here tokens/s on the transformer MoE)."""
-    tokens_per_sec = _bench_moe_impl(mesh, n_dev, dropless=False)
+    tokens_per_sec, measured = _bench_moe_impl(mesh, n_dev, dropless=False,
+                                               measure=True)
     # metric renamed when the model grew from 2 to 8 experts — the old
     # moe_transformer_tokens_per_sec numbers are not comparable
     return {
         "metric": "moe_transformer_e8_tokens_per_sec",
         "value": round(tokens_per_sec, 0),
+        **measured,
         "unit": "tok/s",
         "vs_baseline": None,
     }
@@ -352,11 +363,13 @@ def bench_moe_dropless(mesh, n_dev: int, capacity_tps=None) -> dict:
     Crossover ~12-16K tokens/layer; ``bench_moe_longseq`` records the
     32K point where dropless is the right default."""
     if capacity_tps is None:
-        capacity_tps = _bench_moe_impl(mesh, n_dev, dropless=False)
-    dropless_tps = _bench_moe_impl(mesh, n_dev, dropless=True)
+        capacity_tps, _ = _bench_moe_impl(mesh, n_dev, dropless=False)
+    dropless_tps, measured = _bench_moe_impl(mesh, n_dev, dropless=True,
+                                             measure=True)
     return {
         "metric": "moe_dropless_e8_tokens_per_sec",
         "value": round(dropless_tps, 0),
+        **measured,
         "unit": "tok/s",
         "vs_baseline": round(dropless_tps / capacity_tps, 3),
     }
@@ -367,11 +380,13 @@ def bench_moe_longseq(mesh, n_dev: int) -> dict:
     crossover (see :func:`bench_moe_dropless`): dropless routing is the
     right default in this regime — the capacity path's O(T^2/E) dispatch
     tensor collapses its throughput (measured 1.49x on v5e)."""
-    cap = _bench_moe_impl(mesh, n_dev, dropless=False, seq=4096, timed=5)
-    drop = _bench_moe_impl(mesh, n_dev, dropless=True, seq=4096, timed=5)
+    cap, _ = _bench_moe_impl(mesh, n_dev, dropless=False, seq=4096, timed=5)
+    drop, measured = _bench_moe_impl(mesh, n_dev, dropless=True, seq=4096,
+                                     timed=5, measure=True)
     return {
         "metric": "moe_dropless_seq4096_tokens_per_sec",
         "value": round(drop, 0),
+        **measured,
         "unit": "tok/s",
         "vs_baseline": round(drop / cap, 3),
     }
@@ -401,6 +416,8 @@ def bench_bert(mesh, n_dev: int) -> dict:
     perf = _perf_fields(trainer, state, data, dt, 10)
     try:
         perf.update(_measured_memory_fields(trainer, state, data))
+    except BenchSanityError:
+        raise  # impossible measured rate: re-measure, don't record
     except Exception as e:  # noqa: BLE001 - tracing must not lose a record
         print(f"# measured-memory trace failed: {e}", flush=True)
     seq_per_sec = 10 * batch / dt
@@ -440,6 +457,8 @@ def bench_vgg16(mesh, n_dev: int) -> dict:
     perf = _perf_fields(trainer, state, data, dt, TIMED_STEPS)
     try:
         perf.update(_measured_memory_fields(trainer, state, data))
+    except BenchSanityError:
+        raise  # impossible measured rate: re-measure, don't record
     except Exception as e:  # noqa: BLE001 - tracing must not lose a record
         print(f"# measured-memory trace failed: {e}", flush=True)
     per_device = TIMED_STEPS * batch / dt / n_dev
@@ -531,6 +550,13 @@ def bench_longctx(mesh, n_dev: int) -> dict:
         perf = (
             _perf_fields(trainer, state, data, dt, 10) if want_perf else {}
         )
+        if want_perf:
+            try:
+                perf.update(_measured_memory_fields(trainer, state, data))
+            except BenchSanityError:
+                raise  # impossible measured rate: re-measure, don't record
+            except Exception as e:  # noqa: BLE001
+                print(f"# measured-memory trace failed: {e}", flush=True)
         return 10 * batch * cfg.max_seq_len / dt, perf
 
     flash_tps, perf = run(None, want_perf=True)  # Pallas kernel on TPU
