@@ -93,6 +93,8 @@ class AsyncModelAverageAlgorithm(Algorithm):
         sync_interval_ms: int = 500,
         warmup_steps: int = 0,
         calibration_steps: int = 4,
+        period_steps: Optional[int] = None,
+        recalibrate_rounds: Optional[int] = 64,
     ):
         """
         Args:
@@ -105,12 +107,22 @@ class AsyncModelAverageAlgorithm(Algorithm):
                 before going asynchronous (reference :60).
             calibration_steps: Steps used to measure the (slowest) host's
                 step time before the first round launches.
+            period_steps: Pin the averaging period to an exact step count and
+                skip wall-clock calibration entirely.  Use when the cadence
+                must be machine-load-independent (e.g. convergence gates);
+                ``sync_interval_ms`` is ignored when set.
+            recalibrate_rounds: Re-run the fenced calibration after this many
+                averaging rounds so the agreed period tracks sustained step-
+                time changes (phase recompiles, rebucketing, input-dependent
+                slowdowns).  ``None`` disables; ignored with ``period_steps``.
         """
         assert peer_selection_mode == "all"
         self.peer_selection_mode = peer_selection_mode
         self.sync_interval_ms = sync_interval_ms
         self.warmup_steps = warmup_steps
         self.calibration_steps = max(1, calibration_steps)
+        self.period_steps = period_steps
+        self.recalibrate_rounds = recalibrate_rounds
         self._request = _REQ_NONE    # this rank's pending abort()/resume()
         self._status = _RUNNING      # negotiated, changes only at boundaries
         self._pending: Optional[Any] = None
@@ -118,7 +130,13 @@ class AsyncModelAverageAlgorithm(Algorithm):
         self._period: Optional[int] = None   # agreed steps between rounds
         self._anchor: Optional[int] = None   # step the schedule starts from
         self._calib_t0: Optional[float] = None
+        self._calib_start: Optional[int] = None  # step the window opened at
+        self._calib_skip = 1         # steps to skip before opening a window
+        self._rounds = 0             # rounds since the period was agreed
         self._lock = threading.Lock()
+        # _request has its own tiny lock so abort()/resume() callers never
+        # block behind the boundary's cross-process gather (held under _lock)
+        self._req_lock = threading.Lock()
 
     # ---- traced stages ---------------------------------------------------
 
@@ -164,6 +182,15 @@ class AsyncModelAverageAlgorithm(Algorithm):
         )
         self._snap_fn = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
 
+    def _warm_compiles(self, trainer, params) -> None:
+        """Build + compile the aux jits off the steady-state window (a cache
+        hit later): at a boundary they would land inside the user's training
+        loop — several seconds of remote compile on tunneled devices."""
+        self._ensure_avg_fn(trainer)
+        self._snap_fn.lower(params).compile()
+        self._avg_fn.lower(params).compile()
+        self._combine_fn.lower(params, params, params).compile()
+
     def _apply_pending(self, state, watchdog=None, block=False):
         """Apply the in-flight round to ``state`` (caller holds the lock).
 
@@ -202,26 +229,32 @@ class AsyncModelAverageAlgorithm(Algorithm):
         (observed to mis-calibrate the period by 5x either way).  The
         averaging/combine/snapshot jits are also compiled HERE — at the
         first boundary they would land inside the user's steady-state
-        window (several seconds of remote compile on tunneled devices)."""
-        # skip the first post-warmup step: it may include trace/compile time
-        start = self.warmup_steps + 2
-        if step == start:
-            self._ensure_avg_fn(trainer)
-            # warm the compiles off the measured window (cache hit later)
-            p = state.params
-            self._snap_fn.lower(p).compile()
-            self._avg_fn.lower(p).compile()
-            self._combine_fn.lower(p, p, p).compile()
+        window (several seconds of remote compile on tunneled devices).
+
+        Restartable: periodic re-calibration (``recalibrate_rounds``) resets
+        the window state and re-enters here, so a sustained step-time change
+        (recompile, rebucketing) re-derives the period deterministically on
+        all processes."""
+        if self._calib_skip > 0:
+            # skip step(s) right after warmup / a recalibration trigger:
+            # they may include trace/compile time
+            self._calib_skip -= 1
+            return
+        if self._calib_start is None:
+            self._warm_compiles(trainer, state.params)
             np.asarray(state.step)  # fence: start from a drained pipeline
             self._calib_t0 = time.monotonic()
-        elif step == start + self.calibration_steps:
+            self._calib_start = step
+        elif step >= self._calib_start + self.calibration_steps:
             np.asarray(state.step)  # fence: include the full device work
-            local_dt = (time.monotonic() - self._calib_t0) / self.calibration_steps
+            window = step - self._calib_start
+            local_dt = (time.monotonic() - self._calib_t0) / window
             agreed_dt = _agree_max(local_dt, watchdog, "async-calibrate")
             self._period = max(
                 1, int(round(self.sync_interval_ms / (agreed_dt * 1000.0)))
             )
             self._anchor = step
+            self._rounds = 0
             logger.info(
                 "async model average: agreed step time %.4fs (local %.4fs) "
                 "-> averaging every %d step(s)",
@@ -244,21 +277,35 @@ class AsyncModelAverageAlgorithm(Algorithm):
         step = trainer._step_counter
         if step <= self.warmup_steps:
             return state
+        if trainer._comm.nranks() == 1:
+            # the averaging collective is an identity on a 1-rank comm world:
+            # skip snapshot/avg/combine entirely (the reference's async CI
+            # floor is the HIGHEST of all families — async must never cost;
+            # round 4 measured ~10% single-chip overhead from these hops)
+            return state
         watchdog = getattr(trainer, "_watchdog", None)
         with self._lock:
             if self._period is None:
-                self._calibrate(trainer, state, step, watchdog)
+                if self.period_steps is not None:
+                    # pinned cadence: no wall-clock dependence at all
+                    self._warm_compiles(trainer, state.params)
+                    self._period = max(1, int(self.period_steps))
+                    self._anchor = step
+                    self._rounds = 0
+                else:
+                    self._calibrate(trainer, state, step, watchdog)
                 return state
             if (step - self._anchor) % self._period != 0:
                 return state
             # ---- scheduled boundary: negotiate, drain, launch ------------
             # every process reaches this branch at the same step, so the
             # control allgather and the collectives below line up globally.
-            # Requests are edge-triggered: consume BEFORE the blocking
-            # gather, so an abort()/resume() issued from another thread
-            # while the gather is in flight stays pending for the next
+            # Requests are edge-triggered: the atomic read-then-clear under
+            # _req_lock means an abort()/resume() issued from another thread
+            # while the gather below is in flight stays pending for the next
             # boundary instead of being wiped.
-            my_req, self._request = self._request, _REQ_NONE
+            with self._req_lock:
+                my_req, self._request = self._request, _REQ_NONE
             req = _agree_max(float(my_req), watchdog)
             if req >= _REQ_ABORT:
                 new_status = _ABORTED
@@ -276,6 +323,29 @@ class AsyncModelAverageAlgorithm(Algorithm):
                 # the previous round was launched by all processes; drain it
                 # deterministically whether we stay running or just aborted
                 state = self._apply_pending(state, watchdog)
+            if self._status == _RUNNING:
+                # only RUNNING boundaries count as averaging rounds: during
+                # an abort window no rounds run, so recalibration must not
+                # fire there (it would repeatedly drain the pipeline and
+                # stall a pending resume behind a fresh calibration window)
+                self._rounds += 1
+            if (
+                self._status == _RUNNING
+                and self.period_steps is None
+                and self.recalibrate_rounds is not None
+                and self._rounds >= self.recalibrate_rounds
+            ):
+                # periodic re-calibration: reset the window state machine so
+                # the period re-derives from CURRENT step time.  Step-count
+                # driven, hence simultaneous on every process.
+                self._period = None
+                self._calib_start = None
+                self._calib_skip = 1
+                logger.info(
+                    "async model average: recalibrating period at step %d "
+                    "after %d rounds", step, self._rounds,
+                )
+                return state
             if self._status == _RUNNING:
                 self._ensure_avg_fn(trainer)
                 # snapshot = explicit copy (the reference op copies weights on
@@ -296,12 +366,14 @@ class AsyncModelAverageAlgorithm(Algorithm):
         simultaneously (the reference's negotiated ABORT, :203-218); may be
         called from any single rank — and cleared by a ``resume()`` from any
         rank, not just the one that aborted."""
-        self._request = _REQ_ABORT
+        with self._req_lock:
+            self._request = _REQ_ABORT
         logger.info("async model average abort requested")
 
     def resume(self):
         """Request that background averaging resumes (negotiated RESUME)."""
-        self._request = _REQ_RESUME
+        with self._req_lock:
+            self._request = _REQ_RESUME
         logger.info("async model average resume requested")
 
     def barrier(self, trainer, state):

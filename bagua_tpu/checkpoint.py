@@ -78,15 +78,83 @@ class BaguaCheckpointManager:
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
         _LIVE_MANAGERS.add(self)
 
-    def save(self, step: int, state: Any) -> bool:
+    def save(self, step: int, state: Any, metadata: Optional[dict] = None) -> bool:
         """Queue a save (async by default); returns False when skipped by the
-        save-interval policy."""
-        return self._mgr.save(int(step), args=self._ocp.args.StandardSave(state))
+        save-interval policy.
+
+        ``metadata``: an optional JSON-serializable layout descriptor stored
+        alongside the state (use ``trainer.checkpoint_layout_metadata()``) and
+        validated on :meth:`restore` via ``expect_metadata=``.  Required in
+        practice for the flat-resident ZeRO layout, whose on-disk shapes are
+        bucket-plan- and world-size-dependent."""
+        if metadata is None:
+            return self._mgr.save(
+                int(step), args=self._ocp.args.StandardSave(state)
+            )
+        return self._mgr.save(
+            int(step),
+            args=self._ocp.args.Composite(
+                state=self._ocp.args.StandardSave(state),
+                layout=self._ocp.args.JsonSave(metadata),
+            ),
+        )
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def restore(self, state_like: Any, step: Optional[int] = None) -> Tuple[int, Any]:
+    def _has_layout_item(self, step: int) -> bool:
+        # epath (an orbax dependency) resolves gs://, s3:// etc. — a raw
+        # os.path probe would silently skip layout validation on the remote
+        # checkpoint directories orbax itself supports
+        from etils import epath
+
+        return (epath.Path(self.directory) / str(int(step)) / "layout").exists()
+
+    @staticmethod
+    def _check_layout(saved: Optional[dict], expected: Optional[dict]) -> None:
+        if expected is None:
+            if saved is not None and saved.get("plan_dependent"):
+                logger.warning(
+                    "checkpoint was saved in a plan-dependent layout (%s) but "
+                    "no expect_metadata was passed — restore cannot verify the "
+                    "bucket plan/world size still match", saved.get("layout"),
+                )
+            return
+        if saved is None:
+            logger.warning(
+                "expect_metadata given but the checkpoint carries no layout "
+                "metadata (saved before metadata support, or without "
+                "metadata=) — cannot verify layout compatibility"
+            )
+            return
+        mismatched = {
+            k: (saved.get(k), expected[k])
+            for k in expected
+            if saved.get(k) != expected[k]
+        }
+        if mismatched:
+            detail = ", ".join(
+                f"{k}: checkpoint={a!r} vs current={b!r}"
+                for k, (a, b) in sorted(mismatched.items())
+            )
+            raise ValueError(
+                "checkpoint layout mismatch — this checkpoint cannot restore "
+                f"into the current trainer ({detail}).  The flat-resident "
+                "ZeRO layout is bucket-plan- and world-size-dependent: an "
+                "elastic restart at a different process count or a "
+                "bucket_bytes change produces different flat-buffer shapes.  "
+                "Either restart with the original world size/bucket_bytes, "
+                "or re-save the checkpoint in the plan-independent leaf "
+                "layout (trainer.unstack_params(state)) before changing the "
+                "topology."
+            )
+
+    def restore(
+        self,
+        state_like: Any,
+        step: Optional[int] = None,
+        expect_metadata: Optional[dict] = None,
+    ) -> Tuple[int, Any]:
         """Restore the given (or latest) step.  ``state_like`` provides the
         target pytree structure/shapes/shardings — pass a freshly-initialized
         ``TrainState``; its buffers are replaced by the checkpoint values.
@@ -126,17 +194,37 @@ class BaguaCheckpointManager:
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
 
         abstract = jax.tree.map(abstract_leaf, state_like)
+        if self._has_layout_item(step):
+            # validate the layout FIRST: the actionable mismatch error must
+            # fire before orbax hits an opaque flat-shape mismatch
+            meta = self._mgr.restore(
+                int(step),
+                args=self._ocp.args.Composite(
+                    layout=self._ocp.args.JsonRestore()
+                ),
+            )
+            self._check_layout(dict(meta.layout), expect_metadata)
+            out = self._mgr.restore(
+                int(step),
+                args=self._ocp.args.Composite(
+                    state=self._ocp.args.StandardRestore(abstract)
+                ),
+            )
+            return int(step), out.state
+        self._check_layout(None, expect_metadata)
         restored = self._mgr.restore(
             int(step), args=self._ocp.args.StandardRestore(abstract)
         )
         return int(step), restored
 
-    def try_restore(self, state_like: Any) -> Tuple[Optional[int], Any]:
+    def try_restore(
+        self, state_like: Any, expect_metadata: Optional[dict] = None
+    ) -> Tuple[Optional[int], Any]:
         """Restore latest if present, else return (None, state_like) —
         the launcher's resume-on-restart entry point."""
         if self.latest_step() is None:
             return None, state_like
-        return self.restore(state_like)
+        return self.restore(state_like, expect_metadata=expect_metadata)
 
     def wait(self) -> None:
         """Block until queued async saves are durable."""

@@ -1231,6 +1231,38 @@ class BaguaTrainer:
 
         return jax.tree.map(check_and_make, local_batch)
 
+    def checkpoint_layout_metadata(self) -> dict:
+        """Layout descriptor to store alongside checkpoints of this trainer's
+        ``TrainState`` (pass as ``metadata=`` to
+        :meth:`BaguaCheckpointManager.save` and ``expect_metadata=`` on
+        restore).
+
+        The flat-resident ZeRO layout stores params as bucket flat buffers
+        whose shapes depend on the bucket plan (``bucket_bytes`` split +
+        world-size-aligned padding): a checkpoint saved under one plan/world
+        size can only restore under the identical plan/world size.  This
+        signature makes that restriction *detectable* — an elastic restart at
+        a different process count fails with an actionable error instead of
+        an opaque orbax shape mismatch (or, worse, a silent mis-restore).
+        Plan-independent layouts record it too, so any future rebucketing
+        divergence is caught."""
+        import hashlib
+
+        if self._plan is None:
+            raise RuntimeError(
+                "checkpoint_layout_metadata() needs the bucket plan — call "
+                "trainer.init(params) first"
+            )
+        return {
+            "layout": "zero_flat" if self._zero_flat else "leaf",
+            "plan_signature": hashlib.blake2b(
+                repr(self._plan.signature()).encode(), digest_size=8
+            ).hexdigest(),
+            "world_size": int(self._comm.nranks()),
+            "bucket_bytes": int(self.bucket_bytes),
+            "plan_dependent": bool(self._zero_flat),
+        }
+
     def unstack_params(self, state: TrainState):
         """Return params in user shape (for eval/checkpoint): rank 0's copy
         for replicated/gossip state; global ``[n_experts, ...]`` expert leaves

@@ -192,7 +192,13 @@ def trace_memory_traffic(run_step, steps: int = 5, log_dir=None,
 def parse_xplane_memory_traffic(xplane_path: str) -> dict:
     """Aggregate per-op ``memory_access_breakdown`` over every executed op
     occurrence in the TPU device plane.  Memory spaces (op_metrics.proto
-    ``PerformanceInfo.MemoryAccessed.MemorySpace``): 1=HBM, 2=CMEM, 3=VMEM."""
+    ``PerformanceInfo.MemoryAccessed.MemorySpace``): 1=HBM, 2=CMEM, 3=VMEM.
+
+    Scope: the FIRST ``/device:TPU*`` plane only — on a multi-chip trace the
+    returned ``hbm_gb_per_step`` / ``hbm_gbps_measured`` are therefore
+    **per-chip** figures (one chip's traffic), not totals.  That is the
+    convention every bench record uses (``*_per_chip``); do not multiply by
+    chip count without checking the sharding actually balances traffic."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
     from xprof.protobuf import op_metrics_pb2  # noqa: PLC0415
 
