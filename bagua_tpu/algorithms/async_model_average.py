@@ -122,7 +122,9 @@ class AsyncModelAverageAlgorithm(Algorithm):
         self.warmup_steps = warmup_steps
         self.calibration_steps = max(1, calibration_steps)
         self.period_steps = period_steps
-        self.recalibrate_rounds = recalibrate_rounds
+        self.recalibrate_rounds = (
+            None if recalibrate_rounds is None else max(1, recalibrate_rounds)
+        )
         self._request = _REQ_NONE    # this rank's pending abort()/resume()
         self._status = _RUNNING      # negotiated, changes only at boundaries
         self._pending: Optional[Any] = None
@@ -323,15 +325,16 @@ class AsyncModelAverageAlgorithm(Algorithm):
                 # the previous round was launched by all processes; drain it
                 # deterministically whether we stay running or just aborted
                 state = self._apply_pending(state, watchdog)
-            if self._status == _RUNNING:
-                # only RUNNING boundaries count as averaging rounds: during
-                # an abort window no rounds run, so recalibration must not
-                # fire there (it would repeatedly drain the pipeline and
-                # stall a pending resume behind a fresh calibration window)
-                self._rounds += 1
+            if self._status != _RUNNING:
+                return state
+            # ---- RUNNING-only sequence: count the round, maybe
+            # recalibrate, else launch.  Aborted windows run none of this —
+            # recalibration firing there would repeatedly drain the
+            # pipeline and stall a pending resume behind a fresh
+            # calibration window.
+            self._rounds += 1
             if (
-                self._status == _RUNNING
-                and self.period_steps is None
+                self.period_steps is None
                 and self.recalibrate_rounds is not None
                 and self._rounds >= self.recalibrate_rounds
             ):
@@ -346,15 +349,14 @@ class AsyncModelAverageAlgorithm(Algorithm):
                     "after %d rounds", step, self._rounds,
                 )
                 return state
-            if self._status == _RUNNING:
-                self._ensure_avg_fn(trainer)
-                # snapshot = explicit copy (the reference op copies weights on
-                # the torch stream first, rs:50-60): the train step donates
-                # state.params, so the retained snapshot needs its own buffers
-                snapshot = self._snap_fn(state.params)
-                # dispatch is async: train steps keep running while the
-                # averaging collective is in flight
-                self._pending = (self._avg_fn(snapshot), snapshot)
+            self._ensure_avg_fn(trainer)
+            # snapshot = explicit copy (the reference op copies weights on
+            # the torch stream first, rs:50-60): the train step donates
+            # state.params, so the retained snapshot needs its own buffers
+            snapshot = self._snap_fn(state.params)
+            # dispatch is async: train steps keep running while the
+            # averaging collective is in flight
+            self._pending = (self._avg_fn(snapshot), snapshot)
         return state
 
     # ---- control (reference :203-233) -----------------------------------

@@ -86,29 +86,52 @@ class BaguaCheckpointManager:
         alongside the state (use ``trainer.checkpoint_layout_metadata()``) and
         validated on :meth:`restore` via ``expect_metadata=``.  Required in
         practice for the flat-resident ZeRO layout, whose on-disk shapes are
-        bucket-plan- and world-size-dependent."""
-        if metadata is None:
-            return self._mgr.save(
-                int(step), args=self._ocp.args.StandardSave(state)
-            )
-        return self._mgr.save(
-            int(step),
-            args=self._ocp.args.Composite(
-                state=self._ocp.args.StandardSave(state),
-                layout=self._ocp.args.JsonSave(metadata),
-            ),
+        bucket-plan- and world-size-dependent.
+
+        The descriptor is a SIDECAR file (``<dir>/<step>.layout.json``), not
+        an orbax item: orbax locks a manager to one item structure on first
+        use, so a composite item would make mixing metadata and plain saves
+        (or resuming an old checkpoint, then saving) an opaque error.  The
+        state's on-disk format is identical with and without metadata."""
+        saved = self._mgr.save(
+            int(step), args=self._ocp.args.StandardSave(state)
         )
+        if saved and metadata is not None and jax.process_index() == 0:
+            import json
+
+            path = self._layout_path(step)
+            path.write_text(json.dumps(metadata))
+            self._prune_layout_sidecars()
+        return saved
+
+    def _prune_layout_sidecars(self) -> None:
+        """Best-effort: drop sidecars for steps orbax retention has pruned."""
+        try:
+            live = {int(s) for s in self._mgr.all_steps()}
+            for p in self._layout_path(0).parent.glob("*.layout.json"):
+                if int(p.name.split(".")[0]) not in live:
+                    p.unlink()
+        except Exception as e:  # pragma: no cover - fs-backend dependent
+            logger.debug("layout sidecar pruning skipped: %s", e)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def _has_layout_item(self, step: int) -> bool:
+    def _layout_path(self, step: int):
         # epath (an orbax dependency) resolves gs://, s3:// etc. — a raw
         # os.path probe would silently skip layout validation on the remote
         # checkpoint directories orbax itself supports
         from etils import epath
 
-        return (epath.Path(self.directory) / str(int(step)) / "layout").exists()
+        return epath.Path(self.directory) / f"{int(step)}.layout.json"
+
+    def _read_layout(self, step: int) -> Optional[dict]:
+        import json
+
+        path = self._layout_path(step)
+        if not path.exists():
+            return None
+        return json.loads(path.read_text())
 
     @staticmethod
     def _check_layout(saved: Optional[dict], expected: Optional[dict]) -> None:
@@ -132,22 +155,37 @@ class BaguaCheckpointManager:
             for k in expected
             if saved.get(k) != expected[k]
         }
-        if mismatched:
-            detail = ", ".join(
-                f"{k}: checkpoint={a!r} vs current={b!r}"
-                for k, (a, b) in sorted(mismatched.items())
+        if not mismatched:
+            return
+        detail = ", ".join(
+            f"{k}: checkpoint={a!r} vs current={b!r}"
+            for k, (a, b) in sorted(mismatched.items())
+        )
+        plan_dependent = (
+            saved.get("plan_dependent")
+            or expected.get("plan_dependent")
+            or "layout" in mismatched
+        )
+        if not plan_dependent:
+            # leaf-layout state is genuinely plan/world-size independent:
+            # an elastic restart at a different topology restores fine —
+            # surface the difference, don't block it
+            logger.info(
+                "checkpoint layout metadata differs (%s) but both layouts "
+                "are plan-independent; restoring", detail,
             )
-            raise ValueError(
-                "checkpoint layout mismatch — this checkpoint cannot restore "
-                f"into the current trainer ({detail}).  The flat-resident "
-                "ZeRO layout is bucket-plan- and world-size-dependent: an "
-                "elastic restart at a different process count or a "
-                "bucket_bytes change produces different flat-buffer shapes.  "
-                "Either restart with the original world size/bucket_bytes, "
-                "or re-save the checkpoint in the plan-independent leaf "
-                "layout (trainer.unstack_params(state)) before changing the "
-                "topology."
-            )
+            return
+        raise ValueError(
+            "checkpoint layout mismatch — this checkpoint cannot restore "
+            f"into the current trainer ({detail}).  The flat-resident "
+            "ZeRO layout is bucket-plan- and world-size-dependent: an "
+            "elastic restart at a different process count or a "
+            "bucket_bytes change produces different flat-buffer shapes.  "
+            "Either restart with the original world size/bucket_bytes, "
+            "or re-save the checkpoint in the plan-independent leaf "
+            "layout (trainer.unstack_params(state)) before changing the "
+            "topology."
+        )
 
     def restore(
         self,
@@ -194,24 +232,9 @@ class BaguaCheckpointManager:
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
 
         abstract = jax.tree.map(abstract_leaf, state_like)
-        if self._has_layout_item(step):
-            # validate the layout FIRST: the actionable mismatch error must
-            # fire before orbax hits an opaque flat-shape mismatch
-            meta = self._mgr.restore(
-                int(step),
-                args=self._ocp.args.Composite(
-                    layout=self._ocp.args.JsonRestore()
-                ),
-            )
-            self._check_layout(dict(meta.layout), expect_metadata)
-            out = self._mgr.restore(
-                int(step),
-                args=self._ocp.args.Composite(
-                    state=self._ocp.args.StandardRestore(abstract)
-                ),
-            )
-            return int(step), out.state
-        self._check_layout(None, expect_metadata)
+        # validate the layout sidecar FIRST: the actionable mismatch error
+        # must fire before orbax hits an opaque flat-shape mismatch
+        self._check_layout(self._read_layout(step), expect_metadata)
         restored = self._mgr.restore(
             int(step), args=self._ocp.args.StandardRestore(abstract)
         )

@@ -346,3 +346,34 @@ def test_checkpoint_without_metadata_still_restores(tmp_path):
     s2, loss = t2.train_step(s2, batch)
     assert np.isfinite(float(loss))
     mgr.close()
+
+
+def test_mixed_metadata_and_plain_saves_one_manager(tmp_path):
+    """metadata= and plain saves must coexist on ONE manager (the sidecar
+    design: orbax locks a manager to one item structure on first use, so a
+    composite item would make this an opaque error), and leaf-layout
+    metadata differences must NOT block a restore (plan-independent)."""
+    new_trainer, params, batch = _setup()
+    t = new_trainer()
+    s = t.init(params)
+    s, _ = t.train_step(s, batch)
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    assert mgr.save(1, s)                                        # plain
+    assert mgr.save(2, s, metadata=t.checkpoint_layout_metadata())  # sidecar
+    assert mgr.save(3, s)                                        # plain again
+    mgr.wait()
+
+    t2 = new_trainer()
+    s2_init = t2.init(params)
+    # leaf layout: a metadata difference only logs, never raises
+    other = dict(t2.checkpoint_layout_metadata())
+    other["world_size"] = other["world_size"] + 1
+    step, s2 = mgr.restore(s2_init, step=2, expect_metadata=other)
+    assert step == 2
+    s2, loss = t2.train_step(s2, batch)
+    assert np.isfinite(float(loss))
+    # resume path: try_restore picks latest (plain) with expect_metadata
+    step, _ = mgr.try_restore(t2.init(params),
+                              expect_metadata=t2.checkpoint_layout_metadata())
+    assert step == 3
+    mgr.close()
