@@ -66,22 +66,33 @@ def decompress_chunked(mn: jax.Array, mx: jax.Array, payload: jax.Array) -> jax.
     return vals.reshape(-1)
 
 
+# measured crossover (BENCH_r05 kernel-level codec profile, v5e): the fused
+# Pallas compress beats the XLA lowering from ~1 MB f32 chunks up (+9% kernel
+# time) but LOSES below (grid/dispatch overhead dominates at 128 KB chunks);
+# jnp decompress (one elementwise map, fully fused by XLA) beat the Pallas
+# decompress at every measured size.  Chunks at/above this many f32 elems
+# take the Pallas compress.
+_PALLAS_MIN_CHUNK_ELEMS = 1 << 18  # 1 MiB of f32
+
+
 def _codec(comm: BaguaCommunicator):
-    """Pick the codec implementation: the fused Pallas kernels on TPU
-    (single HBM pass, see :mod:`.pallas_codec`), plain jnp elsewhere.
-    ``BAGUA_DISABLE_PALLAS_CODEC=1`` forces the jnp path for A/B checks."""
+    """Pick the codec implementation per MEASURED kernel profile (see
+    module docstring of :mod:`.pallas_codec` and ``BENCH_COMM.json``):
+    Pallas compress on TPU for chunks ≥1 MiB, the XLA lowering otherwise
+    and for every decompress.  ``BAGUA_DISABLE_PALLAS_CODEC=1`` forces the
+    jnp path for A/B checks."""
     import os
 
     on_tpu = comm.mesh.devices.flat[0].platform == "tpu"
     if on_tpu and os.environ.get("BAGUA_DISABLE_PALLAS_CODEC") != "1":
-        from .pallas_codec import (
-            compress_chunked_pallas, decompress_chunked_pallas,
-        )
+        from .pallas_codec import compress_chunked_pallas
 
-        return (
-            lambda v, n: compress_chunked_pallas(v, n),
-            lambda mn, mx, p: decompress_chunked_pallas(mn, mx, p),
-        )
+        def compress(v, n):
+            if v.size // n >= _PALLAS_MIN_CHUNK_ELEMS:
+                return compress_chunked_pallas(v, n)
+            return compress_chunked(v, n)
+
+        return compress, decompress_chunked
     return compress_chunked, decompress_chunked
 
 

@@ -3,12 +3,33 @@
 The perf-critical piece for ByteGrad/QAdam parity (SURVEY.md §7.5): the
 reference fuses this on GPU as CUB DeviceReduce min/max + a quantize kernel
 (/root/reference/rust/bagua-core/bagua-core-internal/kernels/bagua_kernels.cu:269-572)
-— two passes over HBM.  Plain-XLA ``compress_chunked`` also lowers to two
-passes (a reduce then an elementwise map).  These kernels do it in ONE: each
-grid step pulls its chunk into VMEM once, computes the masked min/max on the
-VPU, quantizes in-register, and writes only the u8 payload + two scalars back
-to HBM — halving the codec's HBM traffic, which is what bounds it (the math
-is trivially elementwise).
+— two passes over HBM.  These kernels do it in ONE grid pass: each grid step
+pulls its chunk into VMEM once, computes the masked min/max on the VPU,
+quantizes in-register, and writes only the u8 payload + two scalars back to
+HBM.
+
+**Measured reality (kernel-level xplane profile, v5e, BENCH_COMM.json r5):**
+the picture is size-dependent, and at the two ends it is opposite:
+
+- **small chunks (128 KiB)**: grid overhead dominates — Pallas compress
+  LOSES to the XLA lowering (171 vs 219 GB/s), because XLA fuses the naive
+  two-pass ``compress_chunked`` to near-single-pass HBM traffic anyway
+  (measured ~1.29x input vs the 1.25x ideal).
+- **ByteGrad's default operating point (~1 MiB chunks)**: modest Pallas win
+  (+8%, 339 vs 312 GB/s).
+- **large chunks (8 MiB, the tiled two-pass path)**: XLA's chunk-reduction
+  schedule collapses (35 GB/s, 1.9 ms/call) while the tiled Pallas kernels
+  hold 247 GB/s — a **7x** kernel-time win; this is where the Pallas codec
+  pays for itself.
+
+The Pallas *decompress* lost to the XLA elementwise lowering at every
+measured size (221 vs 383 GB/s at 8 MB), so
+:func:`bagua_tpu.compression.minmax_uint8._codec` routes decompress to jnp
+and compress to Pallas only at >=1 MiB chunks.  Both paths pay one u8
+payload re-layout (flat <-> (rows,128) tiling) that bounds further gains.
+(Mosaic custom-calls report no ``memory_access_breakdown``, so Pallas HBM
+ratios cannot be read off the profile; the comparison above uses kernel
+time, which IS instrumented.)
 
 Chunks bigger than VMEM can't do it in one: past ``_MAX_FUSED_ROWS`` the
 codec switches to a TILED two-pass — a min/max accumulation kernel (output

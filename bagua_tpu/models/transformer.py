@@ -269,16 +269,9 @@ class TransformerLM(nn.Module):
         pos_slice = jax.lax.dynamic_slice_in_dim(pos, start, s, axis=0)
         x = x + pos_slice[None].astype(cfg.dtype)
         if cfg.remat:
-            policy = {
-                None: None,
-                "dots": jax.checkpoint_policies.dots_saveable,
-                "dots_no_batch":
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            }[cfg.remat_policy]
-            block_cls = (
-                nn.checkpoint(Block, policy=policy) if policy is not None
-                else nn.checkpoint(Block)
-            )
+            from ..utils import remat_wrap
+
+            block_cls = remat_wrap(Block, cfg.remat_policy)
         else:
             block_cls = Block
         for i in range(cfg.n_layers):

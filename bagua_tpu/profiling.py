@@ -189,6 +189,94 @@ def trace_memory_traffic(run_step, steps: int = 5, log_dir=None,
             shutil.rmtree(d, ignore_errors=True)
 
 
+def trace_op_profile(run, log_dir=None, finalize=None) -> dict:
+    """Like :func:`trace_memory_traffic` but returns the PER-OP kernel
+    profile (:func:`parse_xplane_op_profile`) — the tool for measuring one
+    kernel's on-device time and HBM traffic in isolation, where wall-clock
+    timing would measure the host dispatch round-trip instead (on tunneled
+    transports that is milliseconds against a microsecond kernel)."""
+    import glob
+    import shutil
+    import tempfile
+
+    import jax
+
+    owned = log_dir is None
+    d = log_dir or tempfile.mkdtemp(prefix="bagua_optrace_")
+    try:
+        with jax.profiler.trace(d):
+            run()
+            if finalize is not None:
+                finalize()
+        files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
+        if not files:
+            return {}
+        try:
+            return parse_xplane_op_profile(files[-1])
+        except Exception as e:  # pragma: no cover - proto availability varies
+            logger.info("xplane parse unavailable: %s", e)
+            return {}
+    finally:
+        if owned:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def parse_xplane_op_profile(xplane_path: str) -> dict:
+    """Per-op kernel time + measured memory traffic from the first TPU
+    plane's ``XLA Ops`` line (per-chip scope, like
+    :func:`parse_xplane_memory_traffic`).
+
+    Returns ``{"ops": {name: {"time_s", "count", "hbm_gb", "vmem_gb",
+    "cmem_gb"}}, "total_time_s", "total_hbm_gb", "total_vmem_gb"}`` —
+    ``time_s`` is the op's on-device duration summed over occurrences, so
+    the totals over a trace window containing ONLY the kernel under test
+    are that kernel's true device time/traffic, independent of host
+    dispatch latency."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
+    from xprof.protobuf import op_metrics_pb2  # noqa: PLC0415
+
+    xs = xplane_pb2.XSpace()
+    with open(xplane_path, "rb") as f:
+        xs.ParseFromString(f.read())
+    plane = next(
+        (p for p in xs.planes if p.name.startswith("/device:TPU")), None
+    )
+    if plane is None:
+        return {}
+    smd = plane.stat_metadata
+    emd = plane.event_metadata
+    ops: dict = {}
+    for line in plane.lines:
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            name = emd[ev.metadata_id].name
+            rec = ops.setdefault(
+                name, {"time_s": 0.0, "count": 0,
+                       "hbm_gb": 0.0, "cmem_gb": 0.0, "vmem_gb": 0.0}
+            )
+            rec["time_s"] += ev.duration_ps / 1e12
+            rec["count"] += 1
+            for s in emd[ev.metadata_id].stats:
+                if smd[s.metadata_id].name == "memory_access_breakdown":
+                    mab = op_metrics_pb2.MemoryAccessBreakdown()
+                    mab.ParseFromString(s.bytes_value)
+                    for acc in mab.memory_accessed:
+                        key = {1: "hbm_gb", 2: "cmem_gb", 3: "vmem_gb"}.get(
+                            acc.memory_space
+                        )
+                        if key:
+                            rec[key] += acc.bytes_accessed / 1e9
+    if not ops:
+        return {}
+    return {
+        "ops": ops,
+        "total_time_s": sum(r["time_s"] for r in ops.values()),
+        "total_hbm_gb": sum(r["hbm_gb"] for r in ops.values()),
+        "total_vmem_gb": sum(r["vmem_gb"] for r in ops.values()),
+    }
+
+
 def parse_xplane_memory_traffic(xplane_path: str) -> dict:
     """Aggregate per-op ``memory_access_breakdown`` over every executed op
     occurrence in the TPU device plane.  Memory spaces (op_metrics.proto

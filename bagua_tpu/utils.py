@@ -202,3 +202,21 @@ def find_free_port(low: int = 20000, high: int = 65000) -> int:
 
 
 logger = logging.getLogger("bagua_tpu")
+
+
+def remat_wrap(block_cls, remat_policy=None):
+    """Wrap a flax module class in ``nn.checkpoint`` with a NAMED policy —
+    the single source of the policy-name map shared by the transformer and
+    ResNet ``remat``/``remat_policy`` knobs (None = recompute everything;
+    "dots" keeps dot_general results; "dots_no_batch" its no-batch-dims
+    variant)."""
+    import flax.linen as nn
+    import jax
+
+    policy = {
+        None: None,
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_no_batch":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }[remat_policy]
+    return nn.checkpoint(block_cls, policy=policy)

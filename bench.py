@@ -222,11 +222,12 @@ def _measured_memory_fields(trainer, state, data) -> dict:
 
 def bench_family(family: str, algo_factory, mesh, n_dev: int,
                  batch_per_device: int = BATCH_PER_DEVICE,
-                 image_dtype=jnp.float32, suffix_config: bool = False) -> dict:
+                 image_dtype=jnp.float32, suffix_config: bool = False,
+                 remat: bool = False) -> dict:
     from bagua_tpu.core.backend import BaguaTrainer
     from bagua_tpu.models.resnet import ResNet50, classification_loss_fn
 
-    model = ResNet50(num_classes=1000)
+    model = ResNet50(num_classes=1000, remat=remat)
     batch = batch_per_device * n_dev
     # bf16 image input halves the input pipeline's HBM traffic (the first
     # conv reads the batch at full resolution); the model computes in bf16
@@ -268,6 +269,8 @@ def bench_family(family: str, algo_factory, mesh, n_dev: int,
             suffix += "_bf16in"
         if batch_per_device != BATCH_PER_DEVICE:
             suffix += f"_b{batch_per_device}"
+        if remat:
+            suffix += "_remat"
     return {
         "metric": f"resnet50_{family}_imgs_per_sec_per_chip{suffix}",
         "value": round(per_device, 1),
@@ -275,6 +278,7 @@ def bench_family(family: str, algo_factory, mesh, n_dev: int,
         "vs_baseline": round(per_device / floor, 3),
         "batch_per_chip": batch_per_device,
         "image_dtype": jnp.dtype(image_dtype).name,
+        "remat": remat,
         **perf,
     }
 
@@ -341,6 +345,10 @@ def bench_moe(mesh, n_dev: int) -> dict:
         **measured,
         "unit": "tok/s",
         "vs_baseline": None,
+        "baseline_rationale": "no reference counterpart: the reference's "
+                              "MoE CI is an exact-loss gate, not a "
+                              "throughput benchmark (benchmark_master.sh:"
+                              "126-153); record tracks round-over-round",
     }
 
 
@@ -392,6 +400,9 @@ def bench_moe_longseq(mesh, n_dev: int) -> dict:
     }
 
 
+BERT_V100_PEAK_TFLOPS = 125.0  # V100 tensor-core peak (AMP), per NVIDIA spec
+
+
 def bench_bert(mesh, n_dev: int) -> dict:
     """BERT-Large-config LM throughput (BASELINE.json: ByteGrad/QAdam on
     BERT-Large SQuAD; seq 384 as in SQuAD fine-tuning)."""
@@ -421,11 +432,29 @@ def bench_bert(mesh, n_dev: int) -> dict:
     except Exception as e:  # noqa: BLE001 - tracing must not lose a record
         print(f"# measured-memory trace failed: {e}", flush=True)
     seq_per_sec = 10 * batch / dt
+    # Baseline (VERDICT r4 #4): the reference publishes BERT-Large finetune
+    # results only as epoch-time charts (README.md:31-36) and paper scaling
+    # curves (arXiv 2107.01499) — no absolute 8xV100 seq/s figure survives
+    # in its repo, so the defensible anchor is MFU-PARITY: grant an AMP
+    # V100 (125 TFLOP/s tensor peak) the SAME model-FLOPs utilization this
+    # chip measures for the identical config.  baseline_per_gpu =
+    # 125e12 * mfu / flops_per_seq; vs_baseline then reduces to the silicon
+    # peak ratio — deliberately generous to the V100, whose published
+    # BERT-Large AMP utilization is below what this chip measures here.
+    vs = None
+    baseline = None
+    if perf.get("mfu") and perf.get("tflops_achieved"):
+        flops_per_seq = perf["tflops_achieved"] * 1e12 / seq_per_sec
+        baseline = BERT_V100_PEAK_TFLOPS * 1e12 * perf["mfu"] / flops_per_seq
+        vs = round(seq_per_sec / baseline, 3)
     return {
         "metric": "bert_large_bytegrad_seqs_per_sec",
         "value": round(seq_per_sec, 2),
         "unit": "seq/s",
-        "vs_baseline": None,
+        "vs_baseline": vs,
+        "baseline_per_gpu_seq_s": round(baseline, 2) if baseline else None,
+        "baseline_method": "MFU-parity vs 125 TFLOP/s AMP V100 "
+                           "(equal-utilization grant; see bench_bert)",
         **perf,
     }
 
@@ -522,6 +551,10 @@ def bench_decode(mesh, n_dev: int) -> dict:
         "value": round(timed * batch * new / dt, 1),
         "unit": "tok/s",
         "vs_baseline": None,
+        "baseline_rationale": "no reference counterpart: the reference is "
+                              "a training framework with no generation/"
+                              "decode path at all; record tracks "
+                              "round-over-round",
         "batch": batch,
     }
 
@@ -678,17 +711,26 @@ def main():
     if args.resnet_sweep:
         records = []
         factory = _algorithms()["gradient_allreduce"]
-        for dtype in (jnp.float32, jnp.bfloat16):
-            for bpd in (128, 256):
-                try:
-                    records.append(_emit(bench_family(
-                        "gradient_allreduce", factory, mesh, n_dev,
-                        batch_per_device=bpd, image_dtype=dtype,
-                        suffix_config=True,
-                    )))
-                except Exception as e:  # noqa: BLE001 - record and continue
-                    print(f"# sweep dtype={dtype} b={bpd} failed: {e}",
-                          flush=True)
+        # dtype x batch grid, then the remat A/B on the bytes-bound trunk
+        # (VERDICT r4 #6): remat trades recompute FLOPs for HBM bytes, and
+        # by shrinking live activations may also admit a larger batch (512)
+        configs = [
+            dict(image_dtype=jnp.float32, batch_per_device=128),
+            dict(image_dtype=jnp.float32, batch_per_device=256),
+            dict(image_dtype=jnp.bfloat16, batch_per_device=128),
+            dict(image_dtype=jnp.bfloat16, batch_per_device=256),
+            dict(image_dtype=jnp.bfloat16, batch_per_device=128, remat=True),
+            dict(image_dtype=jnp.bfloat16, batch_per_device=256, remat=True),
+            dict(image_dtype=jnp.bfloat16, batch_per_device=512, remat=True),
+        ]
+        for cfg in configs:
+            try:
+                records.append(_emit(bench_family(
+                    "gradient_allreduce", factory, mesh, n_dev,
+                    suffix_config=True, **cfg,
+                )))
+            except Exception as e:  # noqa: BLE001 - record and continue
+                print(f"# sweep {cfg} failed: {e}", flush=True)
         with open("BENCH_RESNET_SWEEP.json", "w") as f:
             json.dump(records, f, indent=1)
         return

@@ -274,3 +274,43 @@ def test_four_node_coordinated_gang_restart(tmp_path):
         (tmp_path / f"final_rank{r}.txt").read_text() for r in range(NNODES)
     ]
     assert all(f == finals[0] for f in finals[1:])
+
+
+@pytest.mark.slow
+def test_watchdog_hang_restart_resume(tmp_path):
+    """The FULL watchdog failure chain end-to-end (VERDICT r4 #8), CPU twin
+    of scripts/watchdog_drill.py: a device program wedges inside
+    trainer.train_step -> the hang watchdog times out, flushes queued async
+    checkpoint saves, exits 3 -> the launcher restarts the gang -> the
+    restarted worker resumes from the orbax checkpoint and completes."""
+    env = dict(os.environ)
+    env["BAGUA_TEST_OUT"] = str(tmp_path)
+    env["BAGUA_TEST_STEPS"] = "8"
+    env["BAGUA_TEST_WEDGE_AT_STEP"] = "4"
+    env["BAGUA_TEST_FORCE_CPU"] = "1"
+    env["BAGUA_COMM_TIMEOUT_S"] = "15"
+    env.pop("BAGUA_SERVICE_PORT", None)
+    env.pop("XLA_FLAGS", None)
+    cmd = [
+        sys.executable, "-m", "bagua_tpu.distributed.run",
+        "--nproc_per_node", "1",
+        "--simulate_cpu_devices", "1",
+        "--master_port", str(_free_port()),
+        "--bagua_service_port", "-1",
+        "--max_restarts", "1",
+        os.path.join(REPO, "tests", "workers", "watchdog_drill_worker.py"),
+    ]
+    out = subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True, timeout=600
+    )
+    log = out.stdout + out.stderr
+    sys.stderr.write(log[-3000:])
+    assert out.returncode == 0, log[-2000:]
+    assert "injecting device wedge at step 4" in log
+    assert "hang" in log.lower() or "watchdog" in log.lower()
+    assert "resumed from checkpoint step" in log
+    assert "drill complete" in log
+    assert (tmp_path / "final.txt").exists()
+    # wedged once, resumed once: steps 0-3 before, resume at >= 3
+    resumed_at = int(log.split("resumed from checkpoint step ")[1].split()[0])
+    assert resumed_at >= 2
