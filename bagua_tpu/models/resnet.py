@@ -60,11 +60,12 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     norm_dtype: Any = jnp.bfloat16  # f32 restores the conservative pre-norm cast
     norm_cls: Any = None  # override with SyncBatchNorm for cross-chip stats
-    #: rematerialize each bottleneck block in the backward pass: activations
-    #: are stored only at block boundaries, trading recompute FLOPs for HBM
-    #: bytes — the lever for the bytes-bound conv trunk (the transformer's
-    #: ``remat``/``remat_policy`` ported per VERDICT r4 #6; A/B'd on-chip in
-    #: BENCH_RESNET_SWEEP.json).
+    #: rematerialize each bottleneck block in the backward pass.  Measured
+    #: on v5e (BENCH_RESNET_SWEEP.json r5): a LOSS for ResNet50 throughput
+    #: — conv recompute re-reads activations/weights, ADDING HBM traffic
+    #: (28.1 -> 33.0 GB/step at batch 128) for -18% img/s — so it stays
+    #: off by default; use it only when activation memory, not speed, is
+    #: the binding constraint (it admits batch 512 on one chip).
     remat: bool = False
     #: ``None`` recomputes everything inside a block; ``"dots"`` keeps
     #: dot/conv results (jax.checkpoint_policies.dots_saveable does not
