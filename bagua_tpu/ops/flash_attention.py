@@ -365,7 +365,10 @@ def _enabled() -> bool:
     return os.environ.get("BAGUA_FLASH_ATTENTION", "1") != "0"
 
 
-MIN_FLASH_SEQ = 1024  # below this XLA's fused attention is already faster
+# below this XLA's fused attention is already faster — re-validated r5 at
+# BERT-Large's seq 384: plain 104.7 vs forced-flash 99.2 seq/s at batch 8
+# (BENCH_BERT_SWEEP.json); the kernel pays from ~1k tokens (3.0x at 4096)
+MIN_FLASH_SEQ = 1024
 
 
 def flash_supported(seq: int, head_dim: int, block: int = _LANE) -> bool:

@@ -401,9 +401,12 @@ def bench_moe_longseq(mesh, n_dev: int) -> dict:
 
 
 BERT_V100_PEAK_TFLOPS = 125.0  # V100 tensor-core peak (AMP), per NVIDIA spec
+#: swept 8/16/32 per chip on v5e (r5): see BENCH_BERT_SWEEP.json
+BERT_BATCH_PER_CHIP = 8
 
 
-def bench_bert(mesh, n_dev: int) -> dict:
+def bench_bert(mesh, n_dev: int, batch_per_chip: int = BERT_BATCH_PER_CHIP,
+               suffix_config: bool = False) -> dict:
     """BERT-Large-config LM throughput (BASELINE.json: ByteGrad/QAdam on
     BERT-Large SQuAD; seq 384 as in SQuAD fine-tuning)."""
     from bagua_tpu.algorithms.bytegrad import ByteGradAlgorithm
@@ -414,7 +417,7 @@ def bench_bert(mesh, n_dev: int) -> dict:
 
     cfg = bert_large_config(max_seq_len=384)
     model = TransformerLM(cfg)
-    batch = 8 * n_dev
+    batch = batch_per_chip * n_dev
     tokens = jnp.zeros((batch, cfg.max_seq_len + 1), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens[:2, :-1])["params"]
     trainer = BaguaTrainer(
@@ -447,10 +450,14 @@ def bench_bert(mesh, n_dev: int) -> dict:
         flops_per_seq = perf["tflops_achieved"] * 1e12 / seq_per_sec
         baseline = BERT_V100_PEAK_TFLOPS * 1e12 * perf["mfu"] / flops_per_seq
         vs = round(seq_per_sec / baseline, 3)
+    suffix = (f"_b{batch_per_chip}"
+              if suffix_config and batch_per_chip != BERT_BATCH_PER_CHIP
+              else "")
     return {
-        "metric": "bert_large_bytegrad_seqs_per_sec",
+        "metric": f"bert_large_bytegrad_seqs_per_sec{suffix}",
         "value": round(seq_per_sec, 2),
         "unit": "seq/s",
+        "batch_per_chip": batch_per_chip,
         "vs_baseline": vs,
         "baseline_per_gpu_seq_s": round(baseline, 2) if baseline else None,
         "baseline_method": "MFU-parity vs 125 TFLOP/s AMP V100 "
