@@ -50,7 +50,11 @@ def main():
     state = trainer.init(params)
 
     mgr = BaguaCheckpointManager(args.ckpt_dir, max_to_keep=2)
-    start_step, state = mgr.try_restore(state)
+    # layout metadata: on an elastic restart at a DIFFERENT topology, a
+    # plan-dependent (flat-resident ZeRO) checkpoint fails here with an
+    # actionable error instead of an opaque orbax shape mismatch
+    layout = trainer.checkpoint_layout_metadata()
+    start_step, state = mgr.try_restore(state, expect_metadata=layout)
     if start_step is not None:
         print(f"resumed from checkpoint step {start_step}", flush=True)
         start = start_step + 1
@@ -68,7 +72,7 @@ def main():
             sys.exit(1)
         state, loss = trainer.train_step(state, {"x": x, "y": y})
         if step % args.save_every == 0 or step == args.steps - 1:
-            mgr.save(step, state)
+            mgr.save(step, state, metadata=layout)
         print(f"step {step} loss {float(loss):.6f}", flush=True)
     mgr.close()
     print(f"final_loss {float(loss):.6f}", flush=True)
