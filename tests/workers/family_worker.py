@@ -297,6 +297,45 @@ def main():
         except ValueError as e:
             assert "owns" in str(e), e
 
+    if family == "decentralized":
+        # ragged alltoall_v across REAL processes (each process feeds its
+        # OWNED rank rows): the one eager primitive the r4 multi-rank
+        # allreduce probe did not cover.  Asymmetric counts so a
+        # transposed/mis-offset implementation cannot pass by accident.
+        counts = np.array([[1, 2, 1, 1],
+                           [1, 1, 3, 1],
+                           [2, 1, 1, 1],
+                           [1, 1, 1, 2]], np.int64)[:n_dev, :n_dev]
+        L = int(counts.sum(axis=1).max())
+        send = np.zeros((n_dev, L), np.float32)
+        for r in range(n_dev):
+            off = 0
+            for d in range(n_dev):
+                for j in range(int(counts[r, d])):
+                    send[r, off] = 100 * r + 10 * d + j
+                    off += 1
+        # expected per-rank output: chunks from s=0..n-1 packed consecutively
+        out_size = int(counts.T.sum(axis=1).max())
+        expect = np.zeros((n_dev, out_size), np.float32)
+        for d in range(n_dev):
+            off = 0
+            for s in range(n_dev):
+                in_off = int(counts[s, :d].sum())
+                for j in range(int(counts[s, d])):
+                    expect[d, off] = send[s, in_off + j]
+                    off += 1
+        owned = n_dev // world
+        mine = slice(rank * owned, (rank + 1) * owned)
+        got = bagua_tpu.alltoall_v(send[mine], counts)
+        # shard order is not guaranteed: place each addressable shard by its
+        # global row index
+        got_local = np.zeros((owned, out_size), np.float32)
+        for s in got.addressable_shards:
+            row0 = s.index[0].start or 0
+            got_local[row0 - rank * owned: row0 - rank * owned
+                      + s.data.shape[0]] = np.asarray(s.data)
+        assert np.array_equal(got_local, expect[mine]), (got_local, expect[mine])
+
     out = os.environ["BAGUA_TEST_OUT"]
     with open(os.path.join(out, f"{family}_rank{rank}.txt"), "w") as f:
         f.write(repr([round(v, 6) for v in losses]))
