@@ -50,4 +50,6 @@ def test_perf_fields_empty_analysis_is_silent():
         def step_cost_analysis(self, state, batch):
             return {}
 
-    assert bench._perf_fields(_NoAnalysis(), None, None, 1.0, 10) == {}
+    fields = bench._perf_fields(_NoAnalysis(), None, None, 1.0, 10)
+    # only the methodology marker survives an empty cost analysis
+    assert fields == {"timing": "min_of_2_windows_x10_steps"}
