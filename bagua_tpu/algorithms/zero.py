@@ -75,14 +75,19 @@ class ZeroOptimizerAlgorithm(Algorithm):
         hierarchical: bool = False,
         check_elementwise: bool = True,
     ):
-        if hierarchical:
-            # the reduce_scatter/allgather pair runs flat over the comm
-            # axes; silently ignoring the flag would just perturb the
-            # step-cache key while users believe they enabled staged comm
-            raise NotImplementedError(
-                "ZeroOptimizerAlgorithm has no hierarchical (intra/inter "
-                "staged) reduce-scatter path; use hierarchical=False"
-            )
+        """``hierarchical=True`` (r5): the STAGED layout — optimizer state is
+        sharded over the *intra* axis only (replicated across *inter*), and
+        the per-bucket dance becomes
+
+            reduce_scatter(grads, intra) -> allreduce(chunk, inter)
+            -> shard-local update -> all_gather(params, intra)
+
+        so the inter tier (DCN on multi-pod meshes) carries only
+        ``1/intra_size`` of the flat bytes per step — the same wire shape as
+        the other families' hierarchical mode — at the cost of storing
+        ``1/intra_size`` (not ``1/world``) of the optimizer state per chip.
+        On a mesh without the inter/intra tiers it falls back to the flat
+        path, like the other families' ``hierarchical`` flag."""
         self.optimizer = optimizer if optimizer is not None else optax.adam(1e-3)
         self.clip_global_norm = clip_global_norm
         self.hierarchical = hierarchical
@@ -151,18 +156,49 @@ class ZeroOptimizerAlgorithm(Algorithm):
 
     # ---- chunk helpers ---------------------------------------------------
 
-    @staticmethod
-    def _chunk_size(ctx: AlgorithmContext, flat) -> int:
-        n = ctx.comm.nranks()
+    def _staged(self, ctx: AlgorithmContext) -> bool:
+        """Whether the hierarchical (intra-sharded) layout is active.  Must
+        agree with the trainer's spec-side decision
+        (``BaguaTrainer._zero_staged``).  The staged collectives span
+        exactly inter × intra, so any extra comm axis (e.g. ``sp`` folded
+        into the comm world for partial-grad summation) forces the flat
+        path — staged rs/allreduce would skip that axis's reduction."""
+        return (
+            self.hierarchical
+            and ctx.internode is not None
+            and ctx.intranode is not None
+            and ctx.internode is not ctx.intranode
+            and ctx.world_size
+            == ctx.internode.nranks() * ctx.intranode.nranks()
+        )
+
+    def _shard_comm(self, ctx: AlgorithmContext):
+        """The axis the optimizer state shards over: intra when staged,
+        the full comm world otherwise."""
+        return ctx.intranode if self._staged(ctx) else ctx.comm
+
+    def _chunk_size(self, ctx: AlgorithmContext, flat) -> int:
+        n = self._shard_comm(ctx).nranks()
         assert flat.shape[0] % n == 0, (
-            f"bucket numel {flat.shape[0]} not divisible by world size {n}"
+            f"bucket numel {flat.shape[0]} not divisible by shard count {n}"
         )
         return flat.shape[0] // n
 
     def _my_chunk(self, ctx: AlgorithmContext, flat):
         size = self._chunk_size(ctx, flat)
-        start = ctx.comm.rank() * size
+        start = self._shard_comm(ctx).rank() * size
         return jax.lax.dynamic_slice(flat, (start,), (size,))
+
+    def _avg_scatter(self, ctx: AlgorithmContext, flat):
+        """Average ``flat`` over the whole comm world and return this rank's
+        owned chunk.  Flat: one reduce_scatter over all comm axes.  Staged:
+        reduce_scatter over intra, then allreduce the owned chunk over inter
+        — the global average with only ``1/intra`` of the bytes crossing the
+        inter tier (avg-of-avgs is exact: intra rows are equal-sized)."""
+        if not self._staged(ctx):
+            return ctx.comm.reduce_scatter(flat, ReduceOp.AVG)
+        chunk = ctx.intranode.reduce_scatter(flat, ReduceOp.AVG)
+        return ctx.internode.allreduce(chunk, ReduceOp.AVG)
 
     # ---- optimizer contract ---------------------------------------------
     #
@@ -218,6 +254,14 @@ class ZeroOptimizerAlgorithm(Algorithm):
             return self._optimizer_update_flat(
                 ctx, params, grads, opt_state, algo_state, step
             )
+        if self._staged(ctx):
+            # backend gates this earlier with its own actionable error; the
+            # guard here keeps direct algorithm users honest too
+            raise NotImplementedError(
+                "hierarchical ZeRO supports the flat-resident (pure-dp) "
+                "layout only; drop hierarchical=True when composing with "
+                "tp/pp/expert axes"
+            )
         gflats = ctx.plan.flatten_tree(grads)
         pflats = ctx.plan.flatten_tree(params)
         # grad averaging and sharding in one collective per bucket
@@ -269,15 +313,17 @@ class ZeroOptimizerAlgorithm(Algorithm):
 
     def _optimizer_update_flat(self, ctx: AlgorithmContext, params, grads,
                                opt_state, algo_state, step):
-        gchunks = [
-            ctx.comm.reduce_scatter(gf, ReduceOp.AVG)
-            for gf in grads["flats"]
-        ]
+        shard = self._shard_comm(ctx)
+        gchunks = [self._avg_scatter(ctx, gf) for gf in grads["flats"]]
         if self.clip_global_norm is not None:
+            # chunks across the SHARD axis tile the whole flat exactly once
+            # (staged: chunks are replicated over inter, so summing over
+            # intra alone is the full norm — a comm-world psum would count
+            # every element inter_size times)
             ssq = sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gchunks
             )
-            gnorm = jnp.sqrt(ctx.comm.allreduce(ssq, ReduceOp.SUM))
+            gnorm = jnp.sqrt(shard.allreduce(ssq, ReduceOp.SUM))
             scale = jnp.minimum(1.0, self.clip_global_norm / (gnorm + 1e-12))
             gchunks = [(g * scale.astype(g.dtype)) for g in gchunks]
 
@@ -287,7 +333,10 @@ class ZeroOptimizerAlgorithm(Algorithm):
             pchunk = self._my_chunk(ctx, pf)
             updates, st = self.optimizer.update(gchunk, st, pchunk)
             pchunk = optax.apply_updates(pchunk, updates)
-            new_flats.append(ctx.comm.allgather(pchunk, tiled=True))
+            # re-replicate (rank chunks in rank order over the shard axis;
+            # staged: every inter row gathers the identical chunks, so the
+            # result stays replicated across inter with no inter traffic)
+            new_flats.append(shard.allgather(pchunk, tiled=True))
             new_states.append(st)
         new_params = {"flats": tuple(new_flats), "local": params["local"]}
         return new_params, {"buckets": tuple(new_states),

@@ -150,10 +150,18 @@ class BaguaCheckpointManager:
                 "metadata=) — cannot verify layout compatibility"
             )
             return
+        missing = [k for k in expected if k not in saved]
+        if missing:
+            # keys added after the checkpoint was written (e.g. opt_shards,
+            # r5): legacy sidecars must stay restorable at the same topology
+            logger.warning(
+                "checkpoint layout metadata predates field(s) %s — cannot "
+                "verify those; restoring", ", ".join(sorted(missing)),
+            )
         mismatched = {
-            k: (saved.get(k), expected[k])
+            k: (saved[k], expected[k])
             for k in expected
-            if saved.get(k) != expected[k]
+            if k in saved and saved[k] != expected[k]
         }
         if not mismatched:
             return

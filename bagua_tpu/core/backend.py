@@ -493,6 +493,12 @@ class BaguaTrainer:
                 and self.expert_axis is None
                 and self.pp_axis is None
             )
+            if self._zero_staged() and not self._zero_flat:
+                raise NotImplementedError(
+                    "hierarchical ZeRO supports the flat-resident (pure-dp) "
+                    "layout only; drop hierarchical=True when composing "
+                    "with tp/pp/expert axes"
+                )
             in_spec = P()
             local_spec = P()
             if self._shard_axis is not None or self.expert_axis is not None:
@@ -515,8 +521,16 @@ class BaguaTrainer:
                     algo.init_optimizer_state_local, local_template
                 )
                 local_spec = self._tp_match_spec_tree(local_struct, sharded)
-            self._zero_opt_specs = {"buckets": P(self.comm_axes),
-                                    "local": local_spec}
+            # staged (hierarchical) ZeRO: chunk states stack over INTRA only
+            # and are replicated across inter — must mirror the algorithm's
+            # _staged()/_shard_comm() decision exactly
+            self._zero_opt_specs = {
+                "buckets": (
+                    P(("intra",)) if self._zero_staged()
+                    else P(self.comm_axes)
+                ),
+                "local": local_spec,
+            }
 
             if self._zero_flat:
 
@@ -1022,10 +1036,12 @@ class BaguaTrainer:
                 self.rebucket(decl_buckets)
                 self.bucket_bytes = recommended.bucket_size
         # hierarchical toggle is only meaningful when the mesh has both
-        # tiers, and only for families that implement a staged path (ZeRO's
-        # constructor rejects hierarchical=True; flipping the attribute here
-        # would bypass that guard — autotune is force-disabled for
-        # sharded-opt-state families anyway, so this is belt-and-braces)
+        # tiers, and only for families whose staged path is layout-free.
+        # ZeRO is excluded: its staged mode changes the OPT-STATE SHARDING
+        # (intra vs world chunks), so flipping the flag mid-run would
+        # desync the state layout from the compiled step — autotune is
+        # force-disabled for sharded-opt-state families anyway, so this is
+        # belt-and-braces
         if (
             self._inter is not None
             and self._intra is not None
@@ -1231,6 +1247,25 @@ class BaguaTrainer:
 
         return jax.tree.map(check_and_make, local_batch)
 
+    def _zero_staged(self) -> bool:
+        """Whether hierarchical (intra-sharded) ZeRO is active — the
+        host-side mirror of ``ZeroOptimizerAlgorithm._staged``; the opt
+        state's stacked axis and the algorithm's shard comm must agree.
+
+        The staged collectives span EXACTLY inter × intra, so any extra
+        comm axis (sequence parallelism folds ``sp`` into comm_axes for
+        partial-grad summation) must fall back to the flat path — staged
+        rs/allreduce would silently skip the sp reduction."""
+        return bool(
+            getattr(self.algorithm, "sharded_opt_state", False)
+            and getattr(self.algorithm, "hierarchical", False)
+            and self._inter is not None
+            and self._intra is not None
+            and self._inter is not self._intra
+            and self.world_size
+            == self._inter.nranks() * self._intra.nranks()
+        )
+
     def checkpoint_layout_metadata(self) -> dict:
         """Layout descriptor to store alongside checkpoints of this trainer's
         ``TrainState`` (pass as ``metadata=`` to
@@ -1253,7 +1288,7 @@ class BaguaTrainer:
                 "checkpoint_layout_metadata() needs the bucket plan — call "
                 "trainer.init(params) first"
             )
-        return {
+        meta = {
             "layout": "zero_flat" if self._zero_flat else "leaf",
             "plan_signature": hashlib.blake2b(
                 repr(self._plan.signature()).encode(), digest_size=8
@@ -1262,6 +1297,15 @@ class BaguaTrainer:
             "bucket_bytes": int(self.bucket_bytes),
             "plan_dependent": bool(self._zero_flat),
         }
+        if getattr(self.algorithm, "sharded_opt_state", False):
+            # opt-state chunk layout depends on the SHARD count, which for
+            # hierarchical ZeRO is the intra size, not the world size — a
+            # restart at the same world but different intra must mismatch
+            meta["opt_shards"] = int(
+                self._intra.nranks() if self._zero_staged()
+                else self._comm.nranks()
+            )
+        return meta
 
     def unstack_params(self, state: TrainState):
         """Return params in user shape (for eval/checkpoint): rank 0's copy

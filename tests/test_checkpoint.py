@@ -377,3 +377,43 @@ def test_mixed_metadata_and_plain_saves_one_manager(tmp_path):
                               expect_metadata=t2.checkpoint_layout_metadata())
     assert step == 3
     mgr.close()
+
+
+def test_legacy_sidecar_missing_new_keys_still_restores(tmp_path):
+    """A sidecar written before a metadata field existed (e.g. opt_shards,
+    r5) must WARN and restore at the same topology — not hard-fail claiming
+    a layout mismatch (r5 review finding)."""
+    from bagua_tpu.algorithms.zero import ZeroOptimizerAlgorithm
+
+    model = MLP(features=(16, 8))
+    mesh = build_mesh({"dp": N_DEVICES})
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+    y = jnp.argmax(x @ jax.random.normal(jax.random.PRNGKey(1), (4, 8)), -1)
+    params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    def new_trainer():
+        return BaguaTrainer(loss_fn, None,
+                            ZeroOptimizerAlgorithm(optax.adam(1e-2)),
+                            mesh=mesh, bucket_bytes=256)
+
+    t = new_trainer()
+    s = t.init(params)
+    s, _ = t.train_step(s, {"x": x, "y": y})
+    legacy = dict(t.checkpoint_layout_metadata())
+    legacy.pop("opt_shards")  # simulate a pre-r5 sidecar
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    assert mgr.save(1, s, metadata=legacy)
+    mgr.wait()
+    t2 = new_trainer()
+    s2 = t2.init(params)
+    step, s2 = mgr.restore(s2, expect_metadata=t2.checkpoint_layout_metadata())
+    assert step == 1
+    s2, loss = t2.train_step(s2, {"x": x, "y": y})
+    assert np.isfinite(float(loss))
+    mgr.close()

@@ -46,6 +46,7 @@ STEPS = {
     "default": 24,
     "async": 60,
     "zero": 30,  # adam's moment warmup needs more steps than sgd
+    "zero_hierarchical": 30,
     "moe_ep": 16,
     "tp_dp": 16,
     "pp_dp": 16,
@@ -79,6 +80,9 @@ def make_algo_and_opt(family):
         return LowPrecisionDecentralizedAlgorithm(), sgd
     if family == "zero":
         return ZeroOptimizerAlgorithm(optax.adam(3e-2)), None
+    if family == "zero_hierarchical":
+        # staged layout over an (inter=2, intra=2) mesh built in main()
+        return ZeroOptimizerAlgorithm(optax.adam(3e-2), hierarchical=True), None
     if family == "async":
         return (
             AsyncModelAverageAlgorithm(
@@ -243,6 +247,10 @@ def main():
         ).mean()
 
     algo, opt = make_algo_and_opt(family)
+    if family == "zero_hierarchical":
+        from bagua_tpu.parallel.mesh import hierarchical_mesh
+
+        mesh = hierarchical_mesh(intra_size=2)
     trainer = bagua_tpu.BaguaTrainer(
         loss_fn, opt, algo, mesh=mesh, bucket_bytes=512
     )
