@@ -417,3 +417,60 @@ def test_legacy_sidecar_missing_new_keys_still_restores(tmp_path):
     s2, loss = t2.train_step(s2, {"x": x, "y": y})
     assert np.isfinite(float(loss))
     mgr.close()
+
+
+def test_save_restore_hierarchical_zero_state(tmp_path):
+    """Checkpoint round-trip for the STAGED (hierarchical) ZeRO layout:
+    intra-stacked chunk states (replicated across inter) must land back on
+    their P('intra') shardings so the jitted step accepts the resumed
+    state, and resumed training must equal the uninterrupted run."""
+    from bagua_tpu.algorithms.zero import ZeroOptimizerAlgorithm
+    from bagua_tpu.parallel.mesh import hierarchical_mesh
+
+    model = MLP(features=(16, 8))
+    mesh = hierarchical_mesh(intra_size=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+    y = jnp.argmax(x @ jax.random.normal(jax.random.PRNGKey(1), (4, 8)), -1)
+    params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    def new_trainer():
+        return BaguaTrainer(
+            loss_fn, None,
+            ZeroOptimizerAlgorithm(optax.adam(1e-2), hierarchical=True),
+            mesh=mesh, bucket_bytes=256,
+        )
+
+    batch = {"x": x, "y": y}
+    t0 = new_trainer()
+    s = t0.init(params)
+    ref = []
+    for _ in range(6):
+        s, loss = t0.train_step(s, batch)
+        ref.append(float(loss))
+
+    t1 = new_trainer()
+    s1 = t1.init(params)
+    for _ in range(3):
+        s1, _ = t1.train_step(s1, batch)
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    meta = t1.checkpoint_layout_metadata()
+    assert meta["opt_shards"] == 4
+    assert mgr.save(3, s1, metadata=meta)
+    mgr.wait()
+
+    t2 = new_trainer()
+    s2 = t2.init(params)
+    step, s2 = mgr.restore(s2, expect_metadata=t2.checkpoint_layout_metadata())
+    assert step == 3
+    resumed = []
+    for _ in range(3):
+        s2, loss = t2.train_step(s2, batch)
+        resumed.append(float(loss))
+    np.testing.assert_allclose(resumed, ref[3:], rtol=1e-6)
+    mgr.close()
