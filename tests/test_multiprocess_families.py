@@ -46,6 +46,9 @@ CONFIGS = [
     ("zero_tp", 2, 2),
     # 4 single-device processes: ≥2-"node" coordination through the launcher
     ("gradient_allreduce", 4, 1),
+    # world 8 across 2 processes: the shift_one ring crossing the process
+    # boundary at the suite's standard world size
+    ("decentralized_shift_one", 2, 4),
 ]
 
 
@@ -60,7 +63,8 @@ def _free_port() -> int:
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "family,nproc,devpp", CONFIGS,
-    ids=[f"{f}-{n}proc" if n != 2 else f for f, n, _ in CONFIGS],
+    ids=[f if (n, d) == (2, 2) else f"{f}-{n}proc{d}dev"
+         for f, n, d in CONFIGS],
 )
 def test_family_multiprocess(family, nproc, devpp, tmp_path):
     env = dict(os.environ)
