@@ -674,6 +674,24 @@ def loss_goldens(n_steps: int = 30) -> dict:
         if hasattr(algo, "abort"):
             algo.abort()
         out[family] = round(float(loss), 6)
+
+    # staged (hierarchical) ZeRO needs a tiered mesh; its reduction order
+    # differs from flat ZeRO (rs(intra)+allreduce(inter)), so it gets its
+    # own exact golden
+    from bagua_tpu.algorithms.zero import ZeroOptimizerAlgorithm
+    from bagua_tpu.parallel.mesh import hierarchical_mesh
+
+    trainer = BaguaTrainer(
+        loss_fn, None,
+        ZeroOptimizerAlgorithm(optax.sgd(0.1, momentum=0.9),
+                               hierarchical=True),
+        mesh=hierarchical_mesh(intra_size=max(1, n_dev // 2)),
+        autotune=False,
+    )
+    state = trainer.init(params)
+    for _ in range(n_steps):
+        state, loss = trainer.train_step(state, {"x": x, "y": y})
+    out["zero_hierarchical"] = round(float(loss), 6)
     return out
 
 
