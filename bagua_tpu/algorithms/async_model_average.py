@@ -169,9 +169,11 @@ class AsyncModelAverageAlgorithm(Algorithm):
             p = jax.tree.map(lambda x: comm.allreduce(x, ReduceOp.AVG), p)
             return jax.tree.map(lambda x: x[None], p)
 
+        from ..compat import shard_map
+
         self._avg_fn = jax.jit(
-            jax.shard_map(avg, mesh=mesh, in_specs=spec, out_specs=spec,
-                          check_vma=False)
+            shard_map(avg, mesh=mesh, in_specs=spec, out_specs=spec,
+                      check_vma=False)
         )
         # apply the averaging as a DELTA onto the current weights, exactly the
         # reference kernel's `x += reduced/n - copy` under the weight lock

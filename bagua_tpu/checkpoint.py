@@ -200,6 +200,7 @@ class BaguaCheckpointManager:
         state_like: Any,
         step: Optional[int] = None,
         expect_metadata: Optional[dict] = None,
+        mesh: Optional[Any] = None,
     ) -> Tuple[int, Any]:
         """Restore the given (or latest) step.  ``state_like`` provides the
         target pytree structure/shapes/shardings — pass a freshly-initialized
@@ -212,7 +213,11 @@ class BaguaCheckpointManager:
         carry a ``SingleDeviceSharding`` — restoring those as-is would commit
         them to one device and the sharded train step would then reject the
         state.  Any leaf without a ``NamedSharding`` is restored replicated
-        over the mesh harvested from its sibling leaves.
+        over ``mesh`` (pass the live mesh explicitly — essential on an
+        ELASTIC restart, where orbax's fallback of reading shardings from
+        the checkpoint file would silently resurrect the OLD topology),
+        falling back to the mesh harvested from sibling leaves, then to the
+        global mesh.
         """
         if step is None:
             step = self.latest_step()
@@ -221,12 +226,16 @@ class BaguaCheckpointManager:
 
         from jax.sharding import NamedSharding, PartitionSpec
 
-        mesh = None
-        for leaf in jax.tree.leaves(state_like):
-            s = getattr(leaf, "sharding", None)
-            if isinstance(s, NamedSharding):
-                mesh = s.mesh
-                break
+        if mesh is None:
+            for leaf in jax.tree.leaves(state_like):
+                s = getattr(leaf, "sharding", None)
+                if isinstance(s, NamedSharding):
+                    mesh = s.mesh
+                    break
+        if mesh is None:
+            from .parallel.mesh import get_global_mesh_if_set
+
+            mesh = get_global_mesh_if_set()
         replicated = (
             NamedSharding(mesh, PartitionSpec()) if mesh is not None else None
         )
@@ -249,13 +258,18 @@ class BaguaCheckpointManager:
         return int(step), restored
 
     def try_restore(
-        self, state_like: Any, expect_metadata: Optional[dict] = None
+        self,
+        state_like: Any,
+        expect_metadata: Optional[dict] = None,
+        mesh: Optional[Any] = None,
     ) -> Tuple[Optional[int], Any]:
         """Restore latest if present, else return (None, state_like) —
         the launcher's resume-on-restart entry point."""
         if self.latest_step() is None:
             return None, state_like
-        return self.restore(state_like, expect_metadata=expect_metadata)
+        return self.restore(
+            state_like, expect_metadata=expect_metadata, mesh=mesh
+        )
 
     def wait(self) -> None:
         """Block until queued async saves are durable."""

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from .compat import shard_map
 
 from . import env
 from .parallel.mesh import build_mesh, get_global_mesh, hierarchical_mesh, mesh_axis_size, set_global_mesh
@@ -367,6 +367,17 @@ def init_process_group(
     """
     if coordinator_address is not None or os.environ.get("BAGUA_COORDINATOR_ADDR"):
         addr = coordinator_address or os.environ["BAGUA_COORDINATOR_ADDR"]
+        # CPU-simulation multiprocess runs need an explicit cross-process
+        # collectives backend on jax versions where the CPU default is
+        # "none" ("Multiprocess computations aren't implemented on the CPU
+        # backend"); gloo is the stdlib-shipped one.  TPU/GPU unaffected.
+        plat = os.environ.get("JAX_PLATFORMS", "") or str(
+            getattr(jax.config, "jax_platforms", None) or "")
+        if "cpu" in plat.lower():
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # pragma: no cover - option renamed/removed
+                pass
         # pass None through when env vars are unset so jax auto-detects;
         # do NOT call jax.process_count() here — it would initialize the
         # local backend and break distributed bring-up

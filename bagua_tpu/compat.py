@@ -1,0 +1,27 @@
+"""jax version-compatibility shims.
+
+The package is written against the modern surface (``jax.shard_map`` with
+``check_vma=``); older runtimes only ship ``jax.experimental.shard_map``
+whose flag is ``check_rep=``.  Importing through here keeps every call site
+on the modern spelling.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma flag
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental home, check_rep flag
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..compat import shard_map
 
 from .. import env
 from ..algorithms.base import Algorithm, AlgorithmContext

@@ -10,24 +10,31 @@ hosts with multiple isolated accelerator sets).  Rendezvous is the JAX
 coordination service (``BAGUA_COORDINATOR_ADDR`` consumed by
 ``bagua_tpu.init_process_group``) instead of a c10d store.  Elastic behavior
 is the honest XLA equivalent of torchelastic's: ANY worker failure kills the
-whole gang and restarts it (same world size) up to ``--max_restarts``, and
-workers resume from the latest checkpoint
-(:mod:`bagua_tpu.checkpoint`) — in-flight world-size *resizing* is impossible
-under XLA's static SPMD compilation, so MIN:MAX nnodes syntax is rejected
-rather than silently accepted.
+whole gang and restarts it up to ``--max_restarts``, and workers resume from
+the latest checkpoint (:mod:`bagua_tpu.checkpoint`).  In-flight world-size
+*resizing* is impossible under XLA's static SPMD compilation, so elastic
+``--nnodes MIN:MAX`` resizes at the only honest point — the restart
+boundary: each attempt is a rendezvous round through
+:mod:`bagua_tpu.elastic` that admits whoever re-registers within the join
+window and respawns the gang at the renegotiated world size.
 
 Multi-node gang restart (reference run.py:116-129 restarts the whole
 multi-node gang via the c10d rendezvous): each node's launcher coordinates
 through a tiny KV store (node 0 hosts a :class:`TCPStoreServer` on
-``--restart_coordinator_port``).  A node observing a local worker failure
-publishes a per-attempt failure flag; every launcher polls it, kills its
-own gang, joins a per-attempt ready barrier, and respawns together — so
-survivors never sit wedged in collectives while one node restarts alone.
+``--restart_coordinator_port``).  Fixed-size jobs: a node observing a local
+worker failure publishes a per-attempt failure flag; every launcher polls
+it, kills its own gang, joins a per-attempt ready barrier, and respawns
+together — so survivors never sit wedged in collectives while one node
+restarts alone.  Elastic jobs replace the fixed-size barrier with the
+membership subsystem: lease heartbeats detect silently lost nodes, standby
+joins force coordinated resizes, and epoch-fenced keys keep zombies from a
+previous attempt out of the current one.
 """
 
 from __future__ import annotations
 
 import argparse
+import concurrent.futures as _futures
 import logging
 import os
 import signal
@@ -38,6 +45,15 @@ from typing import List
 
 logger = logging.getLogger("bagua_tpu.launcher")
 
+# Errors that mean "this store connection is dead, get a new one".
+# TimeoutError needs BOTH spellings: the builtin (an OSError subclass
+# since 3.10) and futures-style timeouts, which store clients can raise
+# as a NON-OSError class on older interpreters — a timed-out socket is
+# as dead as a reset one either way.
+_STORE_RETRY_ERRORS = (
+    ConnectionError, OSError, TimeoutError, _futures.TimeoutError,
+)
+
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(
@@ -45,8 +61,11 @@ def parse_args(argv=None):
         description="bagua_tpu launcher (reference: bagua.distributed.run)",
     )
     p.add_argument("--nnodes", type=str, default="1",
-                   help="number of nodes (fixed; MIN:MAX is rejected — XLA "
-                        "cannot resize in flight, restart with a new value)")
+                   help="number of nodes: a fixed count, or MIN:MAX for "
+                        "elastic mode — each restart attempt renegotiates "
+                        "the world size to whoever rejoins within the join "
+                        "window (resizing happens at restart boundaries; "
+                        "XLA cannot resize a running world)")
     p.add_argument("--node_rank", type=int, default=0)
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="JAX processes per node (default 1: one process "
@@ -62,7 +81,17 @@ def parse_args(argv=None):
                    help="KV-store port for coordinated multi-node restarts "
                         "(default master_port + 1; node 0 hosts it)")
     p.add_argument("--restart_barrier_timeout", type=float, default=300.0,
-                   help="seconds to wait for every node at a restart barrier")
+                   help="seconds to wait for every node at a restart barrier "
+                        "(elastic mode: rendezvous-round timeout)")
+    p.add_argument("--join_window", type=float, default=None,
+                   help="elastic: seconds a rendezvous round stays open for "
+                        "nodes to (re)register (default "
+                        "$BAGUA_ELASTIC_JOIN_WINDOW_S or 30); rounds close "
+                        "early when every expected survivor is back")
+    p.add_argument("--lease_ttl", type=float, default=None,
+                   help="elastic: seconds without a heartbeat before a "
+                        "node's lease expires and the gang regroups without "
+                        "it (default $BAGUA_ELASTIC_LEASE_TTL_S or 15)")
     # Bagua flags (reference run.py:360-398)
     p.add_argument("--bagua_service_port", type=int, default=29500)
     p.add_argument("--default_bucket_size", type=int, default=10 * 1024 ** 2)
@@ -82,29 +111,61 @@ def parse_args(argv=None):
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     if ":" in args.nnodes:
-        p.error("elastic MIN:MAX nnodes is not supported on TPU — world size "
-                "is fixed per launch; restart the job to resize")
-    args.nnodes_int = int(args.nnodes)
+        lo, _, hi = args.nnodes.partition(":")
+        try:
+            args.min_nnodes, args.max_nnodes = int(lo), int(hi)
+        except ValueError:
+            p.error(f"--nnodes {args.nnodes!r}: expected N or MIN:MAX")
+        if not 1 <= args.min_nnodes <= args.max_nnodes:
+            p.error(f"--nnodes {args.nnodes!r}: need 1 <= MIN <= MAX")
+        args.elastic = True
+        args.nnodes_int = args.max_nnodes
+        if not 0 <= args.node_rank < args.max_nnodes:
+            p.error(f"--node_rank {args.node_rank} outside elastic id range "
+                    f"[0, {args.max_nnodes}) — in elastic mode --node_rank "
+                    "is the node's stable identity slot")
+    else:
+        args.elastic = False
+        args.nnodes_int = int(args.nnodes)
+        args.min_nnodes = args.max_nnodes = args.nnodes_int
+    if args.join_window is None:
+        args.join_window = float(
+            os.environ.get("BAGUA_ELASTIC_JOIN_WINDOW_S", "30"))
+    if args.lease_ttl is None:
+        args.lease_ttl = float(
+            os.environ.get("BAGUA_ELASTIC_LEASE_TTL_S", "15"))
     if args.max_restarts is None:
-        # multi-node default stays 0: coordinated restart requires every
-        # node's launcher to be started with the same max_restarts > 0
-        args.max_restarts = 3 if args.nnodes_int == 1 else 0
+        # multi-node fixed-size default stays 0: coordinated restart
+        # requires every node's launcher to use the same max_restarts > 0.
+        # Elastic mode IS the coordinated protocol, so it defaults on.
+        args.max_restarts = 3 if (args.nnodes_int == 1 or args.elastic) else 0
     if args.restart_coordinator_port is None:
         args.restart_coordinator_port = args.master_port + 1
     return args
 
 
-def build_env(args, local_rank: int) -> dict:
-    """Reference ``set_bagua_env`` (run.py:578-600) + rendezvous env."""
+def build_env(args, local_rank: int, spec=None) -> dict:
+    """Reference ``set_bagua_env`` (run.py:578-600) + rendezvous env.
+
+    ``spec`` (elastic mode): the round's renegotiated
+    :class:`~bagua_tpu.elastic.membership.WorldSpec` — world size and this
+    node's DENSE rank come from it instead of the fixed ``--nnodes`` /
+    ``--node_rank``, and the ``BAGUA_ELASTIC_*`` block is injected so
+    workers (and the watchdog's leave-intent path) can reach the
+    membership registry."""
     env = dict(os.environ)
-    world_size = args.nnodes_int * args.nproc_per_node
-    rank = args.node_rank * args.nproc_per_node + local_rank
+    if spec is None:
+        nnodes, node_rank = args.nnodes_int, args.node_rank
+    else:
+        nnodes, node_rank = spec.nnodes, spec.rank_of(args.node_rank)
+    world_size = nnodes * args.nproc_per_node
+    rank = node_rank * args.nproc_per_node + local_rank
     env.update(
         RANK=str(rank),
         WORLD_SIZE=str(world_size),
         LOCAL_RANK=str(local_rank),
         LOCAL_WORLD_SIZE=str(args.nproc_per_node),
-        NODE_RANK=str(args.node_rank),
+        NODE_RANK=str(node_rank),
         MASTER_ADDR=args.master_addr,
         MASTER_PORT=str(args.master_port),
         BAGUA_SERVICE_PORT=str(args.bagua_service_port),
@@ -132,6 +193,20 @@ def build_env(args, local_rank: int) -> dict:
     )
     if world_size > 1:
         env["BAGUA_COORDINATOR_ADDR"] = f"{args.master_addr}:{args.master_port}"
+    else:
+        # an elastic world renegotiated down to ONE node must not inherit a
+        # stale coordinator address and wait for peers that are not coming
+        env.pop("BAGUA_COORDINATOR_ADDR", None)
+    if spec is not None:
+        env.update(
+            BAGUA_ELASTIC="1",
+            BAGUA_ELASTIC_EPOCH=str(spec.epoch),
+            BAGUA_ELASTIC_NODE_ID=str(args.node_rank),
+            BAGUA_ELASTIC_STORE_ADDR=(
+                f"{args.master_addr}:{args.restart_coordinator_port}"),
+            BAGUA_ELASTIC_MIN_NNODES=str(spec.min_nnodes),
+            BAGUA_ELASTIC_MAX_NNODES=str(spec.max_nnodes),
+        )
     if args.simulate_cpu_devices:
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_PLATFORM_NAME"] = "cpu"
@@ -145,12 +220,14 @@ def build_env(args, local_rank: int) -> dict:
     return env
 
 
-def spawn_gang(args) -> List[subprocess.Popen]:
+def spawn_gang(args, spec=None) -> List[subprocess.Popen]:
     cmd_prefix = [] if args.no_python else [sys.executable, "-u"]
     procs = []
     for local_rank in range(args.nproc_per_node):
         cmd = cmd_prefix + [args.training_script] + args.training_script_args
-        procs.append(subprocess.Popen(cmd, env=build_env(args, local_rank)))
+        procs.append(
+            subprocess.Popen(cmd, env=build_env(args, local_rank, spec))
+        )
     return procs
 
 
@@ -189,44 +266,57 @@ class _GangFailure(Exception):
 
 def _connect_restart_store(args, timeout_s: float = 60.0):
     """Client to node 0's restart KV store, with connect retries (peers may
-    start before the server is up)."""
+    start before the server is up).  Retries use jittered exponential
+    backoff: after a gang restart every node reconnects at the same
+    instant, and a fixed-interval poll keeps them in lockstep hammering
+    node 0's accept queue — the jitter de-synchronizes the herd and the
+    exponential cap bounds the total load."""
+    import random
+
     from ..contrib.utils.tcp_store import TCPStore
 
     deadline = time.time() + timeout_s
+    delay = 0.1
     while True:
         try:
             return TCPStore(args.master_addr, args.restart_coordinator_port,
                             timeout_s=timeout_s)
         except OSError:
-            if time.time() > deadline:
+            remaining = deadline - time.time()
+            if remaining <= 0:
                 raise
-            time.sleep(0.5)
+            time.sleep(min(delay * (0.5 + random.random()), remaining))
+            delay = min(delay * 2, 5.0)
 
 
 class _RestartStore:
     """Reconnecting client: a transient socket error (timeout, reset) must
     not permanently blind a node to remote failures — each op retries once
-    on a fresh connection before giving up."""
+    on a fresh connection before giving up, logging which op it retried."""
 
     def __init__(self, args, connect_timeout_s: float = 60.0):
         self._args = args
         self._client = _connect_restart_store(args, connect_timeout_s)
 
-    def _retry(self, op):
+    def _retry(self, opname, op):
         try:
             return op(self._client)
-        except (ConnectionError, OSError):
+        except _STORE_RETRY_ERRORS as e:
+            logger.warning(
+                "restart store %s failed (%s: %s); retrying on a fresh "
+                "connection", opname, type(e).__name__, e,
+            )
             self._client = _connect_restart_store(self._args, timeout_s=5.0)
             return op(self._client)
 
     def set(self, key, value):
-        return self._retry(lambda c: c.set(key, value))
+        return self._retry(f"set({key!r})", lambda c: c.set(key, value))
 
     def get(self, key):
-        return self._retry(lambda c: c.get(key))
+        return self._retry(f"get({key!r})", lambda c: c.get(key))
 
     def mget(self, keys):
-        return self._retry(lambda c: c.mget(keys))
+        return self._retry(f"mget[{len(keys)}]", lambda c: c.mget(keys))
 
 
 def _store_barrier(store, nnodes: int, prefix: str, timeout_s: float,
@@ -354,7 +444,307 @@ def run_multinode(args) -> int:
             server.stop()
 
 
+class _GangStop(Exception):
+    """An elastic attempt ended: somebody failed, left, lost its lease, or
+    asked for a resize.  Carries enough to account for the event and to
+    predict who rejoins at the next round."""
+
+    def __init__(self, kind: str, node: int, reason: str, code: int = 1,
+                 rejoin: bool = True, standby=(), nodes=None):
+        super().__init__(f"{kind} (node {node}): {reason}")
+        self.kind = kind
+        self.node = int(node)
+        self.reason = reason
+        self.code = code
+        self.rejoin = rejoin
+        self.standby = list(standby)
+        # every node the event covers (one lease poll can expire several)
+        self.nodes = [int(n) for n in (nodes or [node])]
+
+
+def monitor_elastic(args, procs, client, spec, coordinator, tracker) -> int:
+    """Monitor one elastic attempt.  Every launcher: watch local workers +
+    the per-epoch stop flag.  The coordinator additionally: expire silent
+    members' leases and scan for standby joiners (scale-up requests), each
+    of which it converts into a stop event the whole gang observes."""
+    from ..elastic import membership as mb
+
+    epoch = spec.epoch
+    store_down_since = None
+    while True:
+        codes = [p.poll() for p in procs]
+        failed = [c for c in codes if c not in (None, 0)]
+        if failed:
+            # a deliberate departure (watchdog exit) left a leave intent
+            # under OUR id — report it as leave, not crash, so membership
+            # telemetry can tell purposeful exits from silent failures
+            kind, reason = mb.STOP_FAIL, f"worker exit {failed[0]}"
+            try:
+                leave = client.read_leave(epoch, args.node_rank)
+                if leave:
+                    kind, reason = mb.STOP_LEAVE, leave
+                client.publish_stop(epoch, kind, args.node_rank, reason)
+            except _STORE_RETRY_ERRORS:
+                logger.warning("restart store unreachable while publishing")
+            kill_gang(procs)
+            raise _GangStop(kind, args.node_rank, reason, code=failed[0])
+        if (
+            store_down_since is None
+            or time.time() - store_down_since > 30.0
+        ):
+            try:
+                stop = client.read_stop(epoch)
+                if store_down_since is not None:
+                    logger.info("restart store reachable again")
+                store_down_since = None
+                if stop is not None:
+                    logger.warning(
+                        "stop event from node %s (%s: %s); killing local "
+                        "gang", stop["node"], stop["kind"], stop["reason"],
+                    )
+                    kill_gang(procs)
+                    raise _GangStop(
+                        stop["kind"], stop["node"], stop["reason"],
+                        rejoin=stop.get("rejoin", True),
+                        nodes=stop.get("nodes"),
+                    )
+                if tracker is not None:
+                    expired = tracker.poll()
+                    if expired:
+                        reason = (
+                            f"no heartbeat for {args.lease_ttl:.0f}s "
+                            f"(node(s) {expired})"
+                        )
+                        client.publish_stop(
+                            epoch, mb.STOP_LEASE_EXPIRED, expired[0],
+                            reason, rejoin=False, nodes=expired,
+                        )
+                        kill_gang(procs)
+                        raise _GangStop(
+                            mb.STOP_LEASE_EXPIRED, expired[0], reason,
+                            rejoin=False, nodes=expired,
+                        )
+                    standby = coordinator.standby_ids(spec)
+                    if standby and spec.nnodes < spec.max_nnodes:
+                        grow = standby[: spec.max_nnodes - spec.nnodes]
+                        reason = f"standby node(s) {grow} joined; scaling up"
+                        client.publish_stop(
+                            epoch, mb.STOP_RESIZE, grow[0], reason)
+                        kill_gang(procs)
+                        raise _GangStop(
+                            mb.STOP_RESIZE, grow[0], reason, standby=grow)
+            except _STORE_RETRY_ERRORS:
+                if store_down_since is None:
+                    logger.warning("restart store unreachable; monitoring "
+                                   "locally (reprobe every 30 s)")
+                store_down_since = time.time()
+        if all(c == 0 for c in codes):
+            return 0
+        time.sleep(args.monitor_interval)
+
+
+def _dump_elastic_telemetry(transitions) -> None:
+    """Write membership counters + the transition log where the operator
+    (or a drill script) asked for them: $BAGUA_ELASTIC_TELEMETRY_OUT."""
+    from ..telemetry import counters
+
+    logger.info("elastic membership counters: %s", counters.snapshot())
+    out = os.environ.get("BAGUA_ELASTIC_TELEMETRY_OUT")
+    if not out:
+        return
+    try:
+        import json
+
+        with open(out, "w") as f:
+            json.dump(
+                {"counters": counters.snapshot(), "transitions": transitions},
+                f, indent=1,
+            )
+    except OSError as e:
+        logger.warning("could not write elastic telemetry to %s: %s", out, e)
+
+
+def run_elastic(args) -> int:
+    """Elastic multi-node launch (``--nnodes MIN:MAX``): every restart
+    attempt is a rendezvous round through the elastic coordinator instead
+    of a fixed-size barrier.  The store-hosting launcher (node id 0) runs
+    the coordinator and is the fixed point — it cannot be resized away;
+    every other node can die (lease expiry / crash → regroup at n-1) or
+    appear (standby join → coordinated resize at the attempt boundary)."""
+    from ..contrib.utils.tcp_store import TCPStoreServer
+    from ..elastic import membership as mb
+    from ..elastic.coordinator import (
+        ElasticCoordinator,
+        ExcludedFromRound,
+        Halted,
+        RendezvousTimeout,
+        join_round,
+        wait_for_next_epoch,
+    )
+    from ..telemetry import counters
+
+    is_coord = args.node_rank == 0
+    server = None
+    if is_coord:
+        server = TCPStoreServer(host="0.0.0.0",
+                                port=args.restart_coordinator_port)
+    transitions: List[dict] = []
+    stop_counter = {
+        mb.STOP_FAIL: "elastic/failures",
+        mb.STOP_LEASE_EXPIRED: "elastic/lease_expired",
+        mb.STOP_LEAVE: "elastic/leaves",
+        mb.STOP_RESIZE: "elastic/resizes",
+    }
+    try:
+        store = _RestartStore(args)
+        client = mb.MembershipClient(store, args.node_rank, args.max_nnodes)
+        coordinator = None
+        if is_coord:
+            coordinator = ElasticCoordinator(
+                client, args.min_nnodes, args.max_nnodes,
+                args.master_addr, args.master_port,
+                join_window_s=args.join_window,
+                timeout_s=args.restart_barrier_timeout,
+            )
+        epoch = 0
+        restarts_used = 0
+        expect = None
+        while True:
+            try:
+                if is_coord:
+                    spec = coordinator.run_round(epoch, expect=expect)
+                else:
+                    spec = join_round(
+                        client, epoch,
+                        timeout_s=args.restart_barrier_timeout,
+                    )
+                    epoch = spec.epoch
+            except ExcludedFromRound as e:
+                logger.warning("%s", e)
+                counters.incr("elastic/excluded")
+                try:
+                    epoch = wait_for_next_epoch(
+                        client, e.epoch,
+                        timeout_s=args.restart_barrier_timeout,
+                    )
+                except Halted as h:
+                    return int(h.verdict.get("code", 1))
+                except RendezvousTimeout as e2:
+                    logger.error("standby wait ended: %s", e2)
+                    return 1
+                continue
+            except Halted as h:
+                logger.info("job already decided: %s", h)
+                return int(h.verdict.get("code", 1))
+            except (RendezvousTimeout, *_STORE_RETRY_ERRORS) as e:
+                logger.error("rendezvous failed at epoch %d: %s", epoch, e)
+                if is_coord:
+                    try:
+                        client.publish_halt(1, f"rendezvous failed: {e}")
+                    except Exception:  # noqa: BLE001 - teardown best-effort
+                        pass
+                return 1
+            counters.incr("elastic/rounds")
+            counters.set_gauge("elastic/world_nnodes", spec.nnodes)
+            transitions.append({
+                "epoch": spec.epoch, "nnodes": spec.nnodes,
+                "members": sorted(spec.ranks),
+            })
+            logger.info(
+                "elastic epoch %d: %d node(s), node id %d -> rank %d",
+                spec.epoch, spec.nnodes, args.node_rank,
+                spec.rank_of(args.node_rank),
+            )
+            hb = mb.LeaseHeartbeat(
+                lambda: _connect_restart_store(args, timeout_s=10.0),
+                args.node_rank, spec.epoch,
+                interval_s=max(0.5, args.lease_ttl / 5.0),
+                max_nnodes=args.max_nnodes,
+            ).start()
+            tracker = None
+            if is_coord:
+                tracker = mb.LeaseTracker(
+                    client, spec.epoch,
+                    [i for i in spec.ranks if i != args.node_rank],
+                    ttl_s=args.lease_ttl,
+                )
+            procs = spawn_gang(args, spec)
+            try:
+                rc = monitor_elastic(
+                    args, procs, client, spec, coordinator, tracker)
+                try:
+                    client.publish_done(spec.epoch)
+                    if is_coord:
+                        # keep the store alive until every member's monitor
+                        # stopped polling it, then post the verdict
+                        deadline = time.time() + 30.0
+                        members = list(spec.ranks)
+                        while time.time() < deadline:
+                            if len(client.done_ids(spec.epoch, members)) == \
+                                    len(members):
+                                break
+                            time.sleep(0.2)
+                        client.publish_halt(0, "success")
+                except Exception:  # noqa: BLE001 - teardown is best-effort
+                    pass
+                return rc
+            except _GangStop as s:
+                counters.incr(stop_counter.get(s.kind, "elastic/failures"))
+                transitions[-1]["stop"] = {
+                    "kind": s.kind, "node": s.node, "reason": s.reason,
+                }
+                survivors = set(spec.ranks)
+                if not s.rejoin:
+                    survivors -= set(s.nodes)
+                expect = survivors | set(s.standby)
+                epoch = spec.epoch + 1
+                if s.kind == mb.STOP_RESIZE:
+                    logger.warning(
+                        "coordinated resize at epoch %d (%s); regrouping "
+                        "as epoch %d", spec.epoch, s.reason, epoch,
+                    )
+                    continue  # resizes are free: not a failure
+                restarts_used += 1
+                counters.incr("elastic/restarts")
+                if restarts_used > args.max_restarts:
+                    logger.error(
+                        "gang stopped (%s); max_restarts=%d exhausted",
+                        s.kind, args.max_restarts,
+                    )
+                    if is_coord:
+                        try:
+                            client.publish_halt(
+                                s.code or 1, "max_restarts exhausted")
+                        except Exception:  # noqa: BLE001
+                            pass
+                    return s.code or 1
+                logger.warning(
+                    "gang stopped at epoch %d (%s from node %d); elastic "
+                    "restart %d/%d as epoch %d", spec.epoch, s.kind,
+                    s.node, restarts_used, args.max_restarts, epoch,
+                )
+            except KeyboardInterrupt:
+                try:
+                    client.publish_leave(spec.epoch, "keyboard interrupt")
+                    client.publish_stop(
+                        spec.epoch, mb.STOP_LEAVE, args.node_rank,
+                        "keyboard interrupt", rejoin=False,
+                    )
+                except Exception:  # noqa: BLE001 - dying anyway
+                    pass
+                kill_gang(procs)
+                return 130
+            finally:
+                hb.stop()
+    finally:
+        _dump_elastic_telemetry(transitions)
+        if server is not None:
+            server.stop()
+
+
 def run(args) -> int:
+    if args.elastic:
+        return run_elastic(args)
     if args.nnodes_int > 1 and args.max_restarts > 0:
         return run_multinode(args)
     attempt = 0

@@ -1,0 +1,45 @@
+"""Elastic membership subsystem: lease-based rendezvous over the restart
+KV store, world-size renegotiation between MIN and MAX nodes, and
+resize-on-restart for the multi-node launcher.
+
+The reference gets elasticity from torchelastic (``bagua.distributed.run``
+wraps ``elastic_launch``; the BAGUA paper lists elastic training as a
+headline v0.8.0 capability).  Under XLA a *running* world cannot resize —
+SPMD programs compile against a fixed device set — so elasticity here is
+implemented at the only boundary where it is honest: the gang-restart
+boundary.  Each restart attempt is a *rendezvous round*: every surviving
+launcher re-registers with the coordinator, whoever shows up within the
+join window is admitted (``min_nnodes <= n <= max_nnodes``), dense node
+ranks are assigned, and the gang respawns at the renegotiated world size,
+resuming from the checkpoint (:mod:`bagua_tpu.checkpoint` restores sharded
+pytrees across topology changes).
+
+Modules:
+
+* :mod:`.membership` — lease-based node registry on the existing TCPStore:
+  per-node heartbeat thread, TTL leases tracked coordinator-side, and
+  epoch-fenced keys so a zombie from attempt N cannot corrupt attempt N+1.
+* :mod:`.coordinator` — rendezvous rounds: open, admit within the join
+  window, decide the world size, assign dense ranks, publish the spec.
+* :mod:`.resize` — worker-side hooks: rebuild the mesh from the
+  renegotiated ``BAGUA_*`` env, drive
+  :meth:`~bagua_tpu.checkpoint.BaguaCheckpointManager.try_restore` onto the
+  new topology, re-split the data shard.
+"""
+
+from .membership import (  # noqa: F401
+    LeaseHeartbeat,
+    LeaseTracker,
+    MembershipClient,
+    WorldSpec,
+    publish_leave_intent,
+)
+from .coordinator import (  # noqa: F401
+    ElasticCoordinator,
+    ExcludedFromRound,
+    Halted,
+    RendezvousTimeout,
+    join_round,
+    wait_for_next_epoch,
+)
+from .resize import ElasticContext, elastic_restore, shard_bounds  # noqa: F401

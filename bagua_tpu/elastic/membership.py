@@ -1,0 +1,346 @@
+"""Lease-based node registry on the restart KV store.
+
+The store (``contrib.utils.tcp_store``) is a plain KV service: no TTLs, no
+deletes, no key scans, no compare-and-swap.  The registry builds leases and
+fencing out of what it does have:
+
+* **Epoch fencing** — every key is namespaced by the rendezvous epoch
+  (= restart attempt number): ``elastic/<epoch>/...``.  A zombie launcher
+  or worker from attempt N keeps writing into N's keyspace, which nobody
+  reads once the coordinator has bumped ``elastic/epoch`` to N+1 — stale
+  writers cannot corrupt the next attempt, they only talk to themselves.
+* **Enumerable node ids** — a node's stable identity is its launcher's
+  ``--node_rank`` in ``[0, max_nnodes)``.  The store cannot list keys, but
+  the coordinator can ``mget`` all ``max_nnodes`` possible slots, which
+  makes membership scans one round-trip.
+* **Leases without synchronized clocks** — members write a monotonically
+  increasing heartbeat *sequence number*; the coordinator timestamps each
+  observed change with ITS OWN clock and expires a lease when the sequence
+  has not advanced for ``ttl_s``.  No cross-host clock comparison ever
+  happens, so clock skew cannot produce false expiries.
+
+Key layout (all under the restart store)::
+
+    elastic/epoch                current epoch, coordinator-owned (fence)
+    elastic/halt                 terminal verdict {code, reason} — job over
+    elastic/<e>/join/<id>        join request {node_id, host, pid}
+    elastic/<e>/world            published WorldSpec (see class below)
+    elastic/<e>/hb/<id>          heartbeat sequence number
+    elastic/<e>/stop             first stop event of the attempt
+                                 {kind, node, reason}; kinds: fail,
+                                 lease_expired, leave, resize
+    elastic/<e>/leave/<id>       leave intent (deliberate departure —
+                                 watchdog exit, SIGINT — vs a silent hang)
+    elastic/<e>/done/<id>        clean completion marker
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("bagua_tpu.elastic")
+
+# stop-event kinds (the first event of an attempt wins; every launcher
+# tears its gang down on whichever it observes)
+STOP_FAIL = "fail"                    # a worker crashed
+STOP_LEASE_EXPIRED = "lease_expired"  # a node's launcher went silent
+STOP_LEAVE = "leave"                  # deliberate departure (watchdog, ^C)
+STOP_RESIZE = "resize"                # standby joined; regroup at n+standby
+
+
+def _k_epoch() -> str:
+    return "elastic/epoch"
+
+
+def _k_halt() -> str:
+    return "elastic/halt"
+
+
+def _k_join(epoch: int, node_id: int) -> str:
+    return f"elastic/{epoch}/join/{node_id}"
+
+
+def _k_world(epoch: int) -> str:
+    return f"elastic/{epoch}/world"
+
+
+def _k_hb(epoch: int, node_id: int) -> str:
+    return f"elastic/{epoch}/hb/{node_id}"
+
+
+def _k_stop(epoch: int) -> str:
+    return f"elastic/{epoch}/stop"
+
+
+def _k_leave(epoch: int, node_id: int) -> str:
+    return f"elastic/{epoch}/leave/{node_id}"
+
+
+def _k_done(epoch: int, node_id: int) -> str:
+    return f"elastic/{epoch}/done/{node_id}"
+
+
+@dataclass
+class WorldSpec:
+    """The renegotiated world published by the coordinator for one epoch:
+    which node ids are in, and the dense rank each one got."""
+
+    epoch: int
+    ranks: Dict[int, int]  # stable node id -> dense node rank
+    min_nnodes: int
+    max_nnodes: int
+    master_addr: str
+    master_port: int
+
+    @property
+    def nnodes(self) -> int:
+        return len(self.ranks)
+
+    def rank_of(self, node_id: int) -> Optional[int]:
+        return self.ranks.get(node_id)
+
+    def to_json(self) -> str:
+        d = dict(self.__dict__)
+        d["ranks"] = {str(k): v for k, v in self.ranks.items()}
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "WorldSpec":
+        d = json.loads(raw)
+        d["ranks"] = {int(k): int(v) for k, v in d["ranks"].items()}
+        return cls(**d)
+
+
+class MembershipClient:
+    """Typed view of the elastic keyspace over any store exposing
+    ``set``/``get``/``mget`` (the launcher's reconnecting ``_RestartStore``
+    or a raw :class:`~bagua_tpu.contrib.utils.tcp_store.TCPStore`)."""
+
+    def __init__(self, store, node_id: int, max_nnodes: int):
+        self.store = store
+        self.node_id = int(node_id)
+        self.max_nnodes = int(max_nnodes)
+
+    # -- epoch fence --------------------------------------------------------
+
+    def current_epoch(self) -> Optional[int]:
+        v = self.store.get(_k_epoch())
+        return int(v) if v is not None else None
+
+    def open_epoch(self, epoch: int) -> None:
+        """Coordinator-only: advance the fence.  Readers of any older
+        epoch's keyspace are now talking to the void."""
+        self.store.set(_k_epoch(), str(int(epoch)))
+
+    # -- join / world -------------------------------------------------------
+
+    def join(self, epoch: int, info: Optional[dict] = None) -> None:
+        payload = {
+            "node_id": self.node_id,
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+        }
+        if info:
+            payload.update(info)
+        self.store.set(_k_join(epoch, self.node_id), json.dumps(payload))
+
+    def joined_ids(self, epoch: int) -> List[int]:
+        keys = [_k_join(epoch, i) for i in range(self.max_nnodes)]
+        vals = self.store.mget(keys)
+        return [i for i, v in enumerate(vals) if v is not None]
+
+    def publish_world(self, spec: WorldSpec) -> None:
+        self.store.set(_k_world(spec.epoch), spec.to_json())
+
+    def read_world(self, epoch: int) -> Optional[WorldSpec]:
+        v = self.store.get(_k_world(epoch))
+        return WorldSpec.from_json(v) if v is not None else None
+
+    # -- heartbeats ---------------------------------------------------------
+
+    def beat(self, epoch: int, seq: int) -> None:
+        self.store.set(_k_hb(epoch, self.node_id), str(int(seq)))
+
+    def read_beats(self, epoch: int, node_ids: List[int]) -> Dict[int, Optional[int]]:
+        vals = self.store.mget([_k_hb(epoch, i) for i in node_ids])
+        return {
+            i: (int(v) if v is not None else None)
+            for i, v in zip(node_ids, vals)
+        }
+
+    # -- stop / leave / done / halt ----------------------------------------
+
+    def publish_stop(self, epoch: int, kind: str, node: int, reason: str,
+                     rejoin: bool = True,
+                     nodes: Optional[List[int]] = None) -> None:
+        """``rejoin=False`` marks the named node(s) as NOT coming back
+        (their launchers are gone — lease expiry, operator ^C), so the next
+        round's early-close set excludes them instead of waiting the full
+        window.  ``nodes`` names EVERY affected node when one event covers
+        several (a rack loss expiring multiple leases in one poll);
+        ``node`` stays the representative for logs."""
+        self.store.set(
+            _k_stop(epoch),
+            json.dumps({"kind": kind, "node": int(node), "reason": reason,
+                        "rejoin": bool(rejoin),
+                        "nodes": [int(n) for n in (nodes or [node])]}),
+        )
+
+    def read_stop(self, epoch: int) -> Optional[dict]:
+        v = self.store.get(_k_stop(epoch))
+        return json.loads(v) if v is not None else None
+
+    def publish_leave(self, epoch: int, reason: str) -> None:
+        self.store.set(_k_leave(epoch, self.node_id), reason)
+
+    def read_leave(self, epoch: int, node_id: int) -> Optional[str]:
+        v = self.store.get(_k_leave(epoch, node_id))
+        return v.decode() if v is not None else None
+
+    def publish_done(self, epoch: int) -> None:
+        self.store.set(_k_done(epoch, self.node_id), b"1")
+
+    def done_ids(self, epoch: int, node_ids: List[int]) -> List[int]:
+        vals = self.store.mget([_k_done(epoch, i) for i in node_ids])
+        return [i for i, v in zip(node_ids, vals) if v is not None]
+
+    def publish_halt(self, code: int, reason: str) -> None:
+        self.store.set(
+            _k_halt(), json.dumps({"code": int(code), "reason": reason})
+        )
+
+    def read_halt(self) -> Optional[dict]:
+        v = self.store.get(_k_halt())
+        return json.loads(v) if v is not None else None
+
+
+class LeaseHeartbeat:
+    """Per-node heartbeat thread: bumps this node's sequence number every
+    ``interval_s`` on its OWN store connection (the monitor loop shares the
+    launcher's main connection; a slow mget there must not delay beats).
+
+    Epoch-fenced: each beat re-reads ``elastic/epoch`` and the thread stops
+    itself the moment the coordinator has moved past the epoch it was
+    started for — a zombie cannot keep a stale lease looking alive."""
+
+    def __init__(self, connect, node_id: int, epoch: int,
+                 interval_s: float = 2.0, max_nnodes: int = 1):
+        self._connect = connect  # () -> store client
+        self._node_id = int(node_id)
+        self._epoch = int(epoch)
+        self._interval_s = float(interval_s)
+        self._max_nnodes = int(max_nnodes)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"bagua-elastic-hb-{node_id}", daemon=True
+        )
+
+    def start(self) -> "LeaseHeartbeat":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        client = None
+        seq = 0
+        while not self._stop.wait(self._interval_s):
+            try:
+                if client is None:
+                    client = MembershipClient(
+                        self._connect(), self._node_id, self._max_nnodes
+                    )
+                fence = client.current_epoch()
+                if fence is not None and fence != self._epoch:
+                    logger.info(
+                        "heartbeat: epoch moved %d -> %d; node %d stops "
+                        "beating into the old keyspace",
+                        self._epoch, fence, self._node_id,
+                    )
+                    return
+                seq += 1
+                client.beat(self._epoch, seq)
+            except (ConnectionError, OSError, TimeoutError):
+                client = None  # reconnect on the next tick
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+@dataclass
+class _LeaseState:
+    seq: Optional[int] = None
+    changed_at: float = field(default_factory=time.monotonic)
+
+
+class LeaseTracker:
+    """Coordinator-side lease bookkeeping: a member's lease expires when its
+    heartbeat sequence stops advancing for ``ttl_s`` (measured on the
+    coordinator's monotonic clock — no cross-host time comparison).  The
+    first ``ttl_s`` after construction is a grace period: a member whose
+    first beat is still in flight is not declared dead."""
+
+    def __init__(self, client: MembershipClient, epoch: int,
+                 member_ids: List[int], ttl_s: float = 10.0):
+        self._client = client
+        self._epoch = int(epoch)
+        self._ttl_s = float(ttl_s)
+        self._leases = {int(i): _LeaseState() for i in member_ids}
+
+    def poll(self) -> List[int]:
+        """One scan; returns member ids whose lease has expired."""
+        beats = self._client.read_beats(
+            self._epoch, list(self._leases)
+        )
+        now = time.monotonic()
+        expired = []
+        for node_id, lease in self._leases.items():
+            seq = beats.get(node_id)
+            if seq is not None and seq != lease.seq:
+                lease.seq = seq
+                lease.changed_at = now
+            elif now - lease.changed_at > self._ttl_s:
+                expired.append(node_id)
+        return expired
+
+    def expire_now(self, node_id: int) -> None:
+        """Force-expire (test hook / explicit eviction)."""
+        self._leases[node_id].changed_at = -float("inf")
+
+
+def publish_leave_intent(reason: str, timeout_s: float = 2.0) -> bool:
+    """Best-effort leave intent from INSIDE a departing process, driven
+    entirely by the ``BAGUA_ELASTIC_*`` env the launcher injected.  Called
+    by the watchdog's abort path (and any other deliberate-exit path) so
+    the coordinator can tell a purposeful departure from a silent hang.
+    Bounded and exception-free: the caller is about to die and must not be
+    delayed by a gone store."""
+    addr = os.environ.get("BAGUA_ELASTIC_STORE_ADDR")
+    if not addr:
+        return False
+    try:
+        from ..contrib.utils.tcp_store import TCPStore
+
+        host, port = addr.rsplit(":", 1)
+        epoch = int(os.environ.get("BAGUA_ELASTIC_EPOCH", "0"))
+        node_id = int(os.environ.get("BAGUA_ELASTIC_NODE_ID", "0"))
+        store = TCPStore(host, int(port), timeout_s=timeout_s)
+        try:
+            store.set(_k_leave(epoch, node_id), reason)
+        finally:
+            try:
+                store._sock.close()
+            except OSError:
+                pass
+        logger.info("published leave intent (node %d, epoch %d): %s",
+                    node_id, epoch, reason)
+        return True
+    except Exception as e:  # noqa: BLE001 - deliberately unconditional
+        logger.debug("leave intent not published: %s", e)
+        return False

@@ -1,0 +1,127 @@
+"""Worker-side resize hooks: consume the renegotiated ``BAGUA_*`` env,
+rebuild the mesh, drive the checkpoint restore onto the new topology, and
+re-split the data shard.
+
+A worker spawned after a rendezvous round sees the standard env protocol
+(``RANK``/``WORLD_SIZE``/``BAGUA_COORDINATOR_ADDR``) already rewritten for
+the renegotiated world, plus the ``BAGUA_ELASTIC_*`` block describing the
+round itself.  Nothing here mutates a live mesh — XLA worlds are static;
+the hooks run at (re)start, which is the only honest resize point.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+logger = logging.getLogger("bagua_tpu.elastic")
+
+
+@dataclass(frozen=True)
+class ElasticContext:
+    """The ``BAGUA_ELASTIC_*`` env block, parsed.  ``enabled`` is False for
+    non-elastic launches (every field then holds its fixed-world value), so
+    workers can call :meth:`from_env` unconditionally."""
+
+    enabled: bool
+    epoch: int
+    node_id: int
+    rank: int
+    world_size: int
+    min_nnodes: int
+    max_nnodes: int
+    store_addr: Optional[str]
+
+    @classmethod
+    def from_env(cls) -> "ElasticContext":
+        e = os.environ
+        rank = int(e.get("RANK", "0"))
+        world = int(e.get("WORLD_SIZE", "1"))
+        return cls(
+            enabled=e.get("BAGUA_ELASTIC") == "1",
+            epoch=int(e.get("BAGUA_ELASTIC_EPOCH", "0")),
+            node_id=int(e.get("BAGUA_ELASTIC_NODE_ID", e.get("NODE_RANK", "0"))),
+            rank=rank,
+            world_size=world,
+            min_nnodes=int(e.get("BAGUA_ELASTIC_MIN_NNODES", "1")),
+            max_nnodes=int(e.get("BAGUA_ELASTIC_MAX_NNODES", str(world))),
+            store_addr=e.get("BAGUA_ELASTIC_STORE_ADDR"),
+        )
+
+    def init_process_group(self, **kwargs):
+        """Rebuild the mesh/communicator for the renegotiated world — a
+        plain :func:`bagua_tpu.init_process_group` call; the renegotiated
+        env is already in place, this hook only names the intent."""
+        import bagua_tpu
+
+        mesh = bagua_tpu.init_process_group(**kwargs)
+        if self.enabled:
+            logger.info(
+                "elastic worker up: epoch %d, rank %d/%d (node id %d, "
+                "min:max %d:%d)", self.epoch, self.rank, self.world_size,
+                self.node_id, self.min_nnodes, self.max_nnodes,
+            )
+        return mesh
+
+
+def shard_bounds(total: int, rank: int, world_size: int) -> Tuple[int, int]:
+    """Contiguous, balanced re-split of ``total`` samples for this rank
+    after a world-size change: every rank gets ``total // world_size``,
+    the first ``total % world_size`` ranks one extra.  Deterministic in
+    ``(total, rank, world_size)`` only, so every member of a renegotiated
+    world derives the identical partition with no extra coordination."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside world of {world_size}")
+    base, rem = divmod(total, world_size)
+    lo = rank * base + min(rank, rem)
+    return lo, lo + base + (1 if rank < rem else 0)
+
+
+def elastic_restore(
+    manager,
+    state_like: Any,
+    expect_metadata: Optional[dict] = None,
+    mesh: Optional[Any] = None,
+) -> Tuple[Optional[int], Any]:
+    """Drive :meth:`BaguaCheckpointManager.try_restore` onto the (possibly
+    resized) topology, surfacing the topology transition in the log.
+
+    The restore itself is topology-agnostic for plan-independent (leaf)
+    layouts — the checkpoint manager rebuilds shardings for the live mesh.
+    Pass ``mesh`` (the LIVE mesh of the renegotiated world) whenever the
+    caller has it: on a topology change the checkpoint file's recorded
+    shardings describe devices that no longer exist, and the restore must
+    be anchored to the new mesh, not to what the file remembers.
+
+    What this hook adds beyond ``try_restore`` is the membership story: it
+    reads the layout sidecar of the step being restored and reports
+    ``saved world -> live world``, and it strips ``world_size`` from the
+    expectation for
+    plan-independent layouts so an elastic restart does not trip the
+    "metadata differs" warning on the one field that is SUPPOSED to differ.
+    Plan-dependent (ZeRO flat) layouts keep the strict check: those
+    checkpoints genuinely cannot cross topologies, and the manager's
+    actionable error must fire."""
+    step = manager.latest_step()
+    if step is None:
+        return None, state_like
+    saved = manager._read_layout(step)
+    expected = expect_metadata
+    if (
+        expected is not None
+        and not expected.get("plan_dependent")
+        and (saved is None or not saved.get("plan_dependent"))
+    ):
+        expected = {k: v for k, v in expected.items() if k != "world_size"}
+    if saved is not None and expect_metadata is not None:
+        was, now = saved.get("world_size"), expect_metadata.get("world_size")
+        if was != now:
+            logger.info(
+                "elastic restore: checkpoint step %d saved at world_size=%s, "
+                "restoring onto world_size=%s", step, was, now,
+            )
+    return manager.restore(
+        state_like, step=step, expect_metadata=expected, mesh=mesh
+    )

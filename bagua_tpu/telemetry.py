@@ -22,16 +22,61 @@ is the fallback when the cost model is unavailable.
 from __future__ import annotations
 
 import logging
+import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
-
-import jax
+from typing import Any, Callable, Dict, List, Optional, Union
 
 logger = logging.getLogger(__name__)
+
+# NOTE: jax is imported lazily inside the span functions — the launcher
+# process consumes the counters below and must not pay (or depend on) a
+# jax import just to count membership transitions.
+
+
+class TelemetryCounters:
+    """Process-wide named counters/gauges (thread-safe).
+
+    The reference exports OTel metrics next to its spans; here the
+    consumers are in-process (the elastic launcher's membership/resize
+    accounting, tests, the drill scripts' JSON artifacts), so a dict under
+    a lock is the whole implementation.  ``incr`` is for monotonic event
+    counts (``elastic/resizes``), ``set_gauge`` for last-value readings
+    (``elastic/world_nnodes``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[str, Union[int, float]] = {}
+
+    def incr(self, name: str, n: int = 1) -> Union[int, float]:
+        with self._lock:
+            self._values[name] = self._values.get(name, 0) + n
+            return self._values[name]
+
+    def set_gauge(self, name: str, value: Union[int, float]) -> None:
+        with self._lock:
+            self._values[name] = value
+
+    def get(self, name: str) -> Union[int, float]:
+        with self._lock:
+            return self._values.get(name, 0)
+
+    def snapshot(self) -> Dict[str, Union[int, float]]:
+        with self._lock:
+            return dict(self._values)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+
+#: process-wide registry (one per process, like the global watchdog)
+counters = TelemetryCounters()
 
 
 def _leaf_cost_flops(fn: Callable, leaf) -> Optional[float]:
     """Static FLOP count of ``jit(fn)(leaf)`` via XLA's cost model."""
+    import jax
+
     try:
         compiled = jax.jit(fn).lower(leaf).compile()
         analysis = compiled.cost_analysis()
@@ -45,6 +90,8 @@ def _leaf_cost_flops(fn: Callable, leaf) -> Optional[float]:
 
 
 def _leaf_cost_walltime(fn: Callable, leaf, repeats: int = 3) -> float:
+    import jax
+
     from .utils import device_fence
 
     compiled = jax.jit(fn)
@@ -68,6 +115,8 @@ def _first_use_costs(loss_fn, params, batch) -> Optional[List[float]]:
     first-use index.  One trace regardless of model size (BERT-Large has
     ~400 leaves; per-leaf compilation would block the first step for hours).
     """
+    import jax
+
     leaves, _ = jax.tree_util.tree_flatten(params)
     try:
         closed = jax.make_jaxpr(lambda p: loss_fn(p, batch))(params)
@@ -105,6 +154,8 @@ def profile_tensor_execution_order(
     uses XLA's FLOP count (more precise, one compile per leaf — only for
     offline analysis of small models).
     """
+    import jax
+
     from .tensor import _name_of_path
 
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
@@ -169,6 +220,8 @@ def profile_tensor_execution_order(
 
 def _set_leaf(tree, target_path, value):
     """Replace the leaf at ``target_path`` with ``value`` (functional)."""
+    import jax
+
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     leaves = [value if path == target_path else leaf for path, leaf in flat]
     return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -179,6 +179,18 @@ class HangWatchdog:
                     faulthandler.dump_traceback(file=sys.stderr)
                     self._armed = False
                 if self.action == "exit":
+                    # elastic jobs: tell the membership registry this is a
+                    # DELIBERATE departure, so the coordinator logs a leave
+                    # (watchdog kill) rather than a silent hang/crash.
+                    # No-op outside elastic mode; bounded; never raises.
+                    try:
+                        from .elastic.membership import publish_leave_intent
+
+                        publish_leave_intent(
+                            f"watchdog: {label} stuck for {dt:.0f} s"
+                        )
+                    except Exception:
+                        pass
                     # flush queued async checkpoint saves first — os._exit
                     # skips atexit handlers, and the whole point of dying is
                     # to restart from the freshest durable checkpoint.

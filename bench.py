@@ -637,19 +637,19 @@ def bench_longctx(mesh, n_dev: int) -> dict:
     }
 
 
-def loss_goldens(n_steps: int = 30) -> dict:
-    """Deterministic final losses per family on a fixed seed/task — the
-    analog of the reference's exact-loss CI gate (benchmark_master.sh:98-108).
-    Platform-specific (reduction orders differ CPU vs TPU); the test asserts
-    them on the 8-device CPU mesh."""
-    from bagua_tpu.core.backend import BaguaTrainer
+def golden_task(batch_size: int = None):
+    """The fixed seed/task of the exact-loss gate, shared with the elastic
+    cross-topology resume gate (tests/test_elastic_resume.py) so a
+    save/resize/restore run is measured against the SAME trajectory the
+    goldens certify.  Returns ``(loss_fn, params, batch)``; the batch is
+    the full global batch — identical under any dp split that divides it,
+    which is what makes final losses comparable across world sizes."""
     from bagua_tpu.models.mlp import MLP
-    from bagua_tpu.parallel.mesh import build_mesh
 
-    n_dev = len(jax.devices())
-    mesh = build_mesh({"dp": n_dev})
+    if batch_size is None:
+        batch_size = 8 * len(jax.devices())
     model = MLP(features=(32, 8))
-    x = jax.random.normal(jax.random.PRNGKey(0), (8 * n_dev, 4))
+    x = jax.random.normal(jax.random.PRNGKey(0), (batch_size, 4))
     y = jnp.argmax(x @ jax.random.normal(jax.random.PRNGKey(1), (4, 8)), -1)
     params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
 
@@ -658,6 +658,22 @@ def loss_goldens(n_steps: int = 30) -> dict:
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, b["y"]
         ).mean()
+
+    return loss_fn, params, {"x": x, "y": y}
+
+
+def loss_goldens(n_steps: int = 30) -> dict:
+    """Deterministic final losses per family on a fixed seed/task — the
+    analog of the reference's exact-loss CI gate (benchmark_master.sh:98-108).
+    Platform-specific (reduction orders differ CPU vs TPU); the test asserts
+    them on the 8-device CPU mesh."""
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh({"dp": n_dev})
+    loss_fn, params, batch = golden_task()
+    x, y = batch["x"], batch["y"]
 
     out = {}
     for family, factory in _algorithms().items():

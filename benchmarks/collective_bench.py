@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from bagua_tpu.compat import shard_map
 
 
 def _bench(fn, x, iters=10, warmup=3):

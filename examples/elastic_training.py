@@ -54,7 +54,11 @@ def main():
     # plan-dependent (flat-resident ZeRO) checkpoint fails here with an
     # actionable error instead of an opaque orbax shape mismatch
     layout = trainer.checkpoint_layout_metadata()
-    start_step, state = mgr.try_restore(state, expect_metadata=layout)
+    # mesh= anchors the restore to the LIVE mesh: on an elastic restart at
+    # a different world size the checkpoint's recorded shardings describe
+    # devices that no longer exist
+    start_step, state = mgr.try_restore(
+        state, expect_metadata=layout, mesh=mesh)
     if start_step is not None:
         print(f"resumed from checkpoint step {start_step}", flush=True)
         start = start_step + 1

@@ -1,0 +1,157 @@
+"""Elastic membership drill (`WATCHDOG_DRILL`-style): kill a node, watch
+the world resize, let it rejoin, watch the world grow back.
+
+Two launchers form a ``--nnodes 1:2`` elastic job on CPU:
+
+1. both nodes train at world size 2;
+2. node 1's WHOLE process group is SIGKILLed (launcher + worker — the
+   "permanently lost node" the fixed-size restart path could never survive);
+3. node 0's coordinator expires node 1's lease, the gang regroups at world
+   size 1 within one join window and resumes from the checkpoint;
+4. node 1 is relaunched, registers as a standby, the coordinator forces a
+   coordinated resize at the attempt boundary, and the job finishes at
+   world size 2 again.
+
+Membership transitions and counters come from the launcher's telemetry
+dump (``BAGUA_ELASTIC_TELEMETRY_OUT``); the verdict is written to
+``ELASTIC_DRILL.json``.
+
+Usage: python scripts/elastic_drill.py
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_for(path: str, needle: str, timeout_s: float) -> bool:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if os.path.exists(path) and needle in open(path).read():
+            return True
+        time.sleep(0.3)
+    return False
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="elastic_drill_")
+    master_port, coord_port = _free_port(), _free_port()
+    env = dict(os.environ)
+    env["BAGUA_TEST_OUT"] = tmp
+    env["BAGUA_TEST_STEPS"] = "45"
+    env["BAGUA_TEST_STEP_DELAY"] = "0.4"
+    env["BAGUA_COMM_TIMEOUT_S"] = "60"  # backstop; the lease should win
+    env.pop("BAGUA_SERVICE_PORT", None)
+
+    logs = {r: os.path.join(tmp, f"node{r}.log") for r in (0, 1)}
+
+    def launch(node_id: int):
+        e = dict(env)
+        e["BAGUA_ELASTIC_TELEMETRY_OUT"] = os.path.join(
+            tmp, f"telemetry_node{node_id}.json")
+        cmd = [
+            sys.executable, "-m", "bagua_tpu.distributed.run",
+            "--nnodes", "1:2", "--node_rank", str(node_id),
+            "--nproc_per_node", "1",
+            "--simulate_cpu_devices", "1",
+            "--master_port", str(master_port),
+            "--restart_coordinator_port", str(coord_port),
+            "--bagua_service_port", "-1",
+            "--max_restarts", "3",
+            "--join_window", "8",
+            "--lease_ttl", "5",
+            "--monitor_interval", "0.3",
+            os.path.join(REPO, "tests", "workers", "elastic_worker.py"),
+        ]
+        # own session: SIGKILLing the group takes launcher AND worker down,
+        # like losing the host
+        return subprocess.Popen(
+            cmd, cwd=REPO, env=e, stdout=open(logs[node_id], "w"),
+            stderr=subprocess.STDOUT, start_new_session=True,
+        )
+
+    t0 = time.time()
+    checks = {}
+    p0 = launch(0)
+    time.sleep(1.0)
+    p1 = launch(1)
+
+    try:
+        checks["trained_at_world_2"] = _wait_for(
+            logs[0], "loss", 180) and _wait_for(logs[0], "world 2", 60)
+
+        print("# killing node 1's process group", flush=True)
+        os.killpg(p1.pid, signal.SIGKILL)
+        p1.wait()
+
+        checks["lease_expired_detected"] = _wait_for(
+            logs[0], "lease_expired", 120)
+        checks["resumed_at_world_1"] = _wait_for(
+            logs[0], "resumed from checkpoint step", 120
+        ) and _wait_for(logs[0], "world 1", 120)
+
+        print("# relaunching node 1 (standby rejoin)", flush=True)
+        p1 = launch(1)
+        checks["resize_on_rejoin"] = _wait_for(logs[0], "resize", 120)
+        checks["resumed_at_world_2"] = _wait_for(
+            logs[1], "world 2", 180)
+
+        rc0 = p0.wait(timeout=300)
+        rc1 = p1.wait(timeout=120)
+        checks["exit_codes"] = [rc0, rc1]
+        checks["completed"] = (
+            rc0 == 0 and rc1 == 0
+            and "final_loss" in open(logs[0]).read()
+        )
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+    telemetry = {}
+    tpath = os.path.join(tmp, "telemetry_node0.json")
+    if os.path.exists(tpath):
+        telemetry = json.load(open(tpath))
+    counters = telemetry.get("counters", {})
+    transitions = telemetry.get("transitions", [])
+    world_sizes = [t["nnodes"] for t in transitions]
+    checks["membership_counters"] = counters
+    checks["world_size_transitions"] = world_sizes
+    checks["counters_show_lease_expiry"] = counters.get(
+        "elastic/lease_expired", 0) >= 1
+    checks["counters_show_resize"] = counters.get("elastic/resizes", 0) >= 1
+    checks["world_shrank_and_regrew"] = (
+        2 in world_sizes and 1 in world_sizes
+        and world_sizes and world_sizes[-1] == 2
+    )
+    checks["wall_s"] = round(time.time() - t0, 1)
+    checks["log_dir"] = tmp
+    checks["ok"] = all(
+        v for k, v in checks.items()
+        if k not in ("exit_codes", "wall_s", "log_dir",
+                     "membership_counters", "world_size_transitions")
+    )
+    print(json.dumps(checks, indent=1))
+    with open(os.path.join(REPO, "ELASTIC_DRILL.json"), "w") as f:
+        json.dump(checks, f, indent=1)
+    sys.exit(0 if checks["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

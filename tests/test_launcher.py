@@ -20,9 +20,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_parse_rejects_elastic_range():
-    with pytest.raises(SystemExit):
-        parse_args(["--nnodes", "1:4", "script.py"])
+def test_parse_accepts_elastic_range():
+    args = parse_args(["--nnodes", "1:4", "script.py"])
+    assert args.elastic
+    assert (args.min_nnodes, args.max_nnodes) == (1, 4)
+    assert args.nnodes_int == 4  # env defaults size to MAX until a round runs
+    fixed = parse_args(["--nnodes", "2", "script.py"])
+    assert not fixed.elastic and fixed.nnodes_int == 2
 
 
 def test_env_injection():
@@ -274,6 +278,88 @@ def test_four_node_coordinated_gang_restart(tmp_path):
         (tmp_path / f"final_rank{r}.txt").read_text() for r in range(NNODES)
     ]
     assert all(f == finals[0] for f in finals[1:])
+
+
+@pytest.mark.slow
+def test_elastic_node_loss_resize_down_then_rejoin_resize_up(tmp_path):
+    """CPU twin of scripts/elastic_drill.py, end-to-end through the real
+    launcher protocol: a 1:2 elastic job trains at world 2; node 1's WHOLE
+    process group is SIGKILLed (launcher + worker — the permanently lost
+    node); node 0's coordinator expires its lease and the job resumes from
+    the checkpoint at world 1; node 1 is relaunched, registers as standby,
+    and a coordinated resize brings the job back to world 2; both exit 0
+    with the membership transitions in the telemetry dump."""
+    import json
+    import signal as _signal
+    import time as _time
+
+    master_port, coord_port = _free_port(), _free_port()
+    env = dict(os.environ)
+    env["BAGUA_TEST_OUT"] = str(tmp_path)
+    env["BAGUA_TEST_STEPS"] = "40"
+    env["BAGUA_TEST_STEP_DELAY"] = "0.4"
+    env.pop("BAGUA_SERVICE_PORT", None)
+    logs = {r: tmp_path / f"node{r}.log" for r in (0, 1)}
+
+    def launch(node_id):
+        e = dict(env)
+        e["BAGUA_ELASTIC_TELEMETRY_OUT"] = str(
+            tmp_path / f"telemetry_node{node_id}.json")
+        cmd = [
+            sys.executable, "-m", "bagua_tpu.distributed.run",
+            "--nnodes", "1:2", "--node_rank", str(node_id),
+            "--nproc_per_node", "1",
+            "--simulate_cpu_devices", "1",
+            "--master_port", str(master_port),
+            "--restart_coordinator_port", str(coord_port),
+            "--bagua_service_port", "-1",
+            "--max_restarts", "3",
+            "--join_window", "8", "--lease_ttl", "5",
+            "--monitor_interval", "0.3",
+            os.path.join(REPO, "tests", "workers", "elastic_worker.py"),
+        ]
+        return subprocess.Popen(
+            cmd, cwd=REPO, env=e, stdout=open(logs[node_id], "w"),
+            stderr=subprocess.STDOUT, start_new_session=True,
+        )
+
+    def wait_in_log(node_id, needle, timeout_s):
+        deadline = _time.time() + timeout_s
+        while _time.time() < deadline:
+            if needle in logs[node_id].read_text():
+                return True
+            _time.sleep(0.3)
+        return False
+
+    p0 = launch(0)
+    _time.sleep(1.0)
+    p1 = launch(1)
+    try:
+        assert wait_in_log(0, "world 2", 180), logs[0].read_text()[-2000:]
+        os.killpg(p1.pid, _signal.SIGKILL)  # lose node 1 entirely
+        p1.wait()
+        assert wait_in_log(0, "lease_expired", 120), \
+            logs[0].read_text()[-2000:]
+        assert wait_in_log(0, "resumed from checkpoint step", 120)
+        assert wait_in_log(0, "world 1", 120)
+        p1 = launch(1)  # standby rejoin -> coordinated resize back up
+        assert wait_in_log(0, "resize", 120), logs[0].read_text()[-2000:]
+        assert wait_in_log(1, "world 2", 180), logs[1].read_text()[-2000:]
+        rc0 = p0.wait(timeout=300)
+        rc1 = p1.wait(timeout=120)
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                os.killpg(p.pid, _signal.SIGKILL)
+    log0 = logs[0].read_text()
+    sys.stderr.write(log0[-3000:])
+    assert rc0 == 0 and rc1 == 0
+    telemetry = json.loads(
+        (tmp_path / "telemetry_node0.json").read_text())
+    worlds = [t["nnodes"] for t in telemetry["transitions"]]
+    assert 2 in worlds and 1 in worlds and worlds[-1] == 2, worlds
+    assert telemetry["counters"].get("elastic/lease_expired", 0) >= 1
+    assert telemetry["counters"].get("elastic/resizes", 0) >= 1
 
 
 @pytest.mark.slow

@@ -17,7 +17,29 @@ import pytest
 import bench
 
 # python bench.py --goldens  (8-device CPU mesh, 30 steps)
-GOLDENS = {
+# Goldens are TOOLCHAIN-specific as well as platform-specific:
+# jax.random changed its sampling between release lines, so the
+# fixed-seed task DATA differs across jax versions, not just reduction
+# order — keyed by jax minor version so each toolchain keeps its own
+# certified values.  The gate's claim is unchanged on every line: each
+# synchronous family is bit-deterministic on this seed/task/mesh.
+import jax
+
+_GOLDENS_BY_JAX = {
+    # jax 0.4 line (regenerated on 0.4.37)
+    "0.4": {
+        "gradient_allreduce": 0.907066,
+        "bytegrad": 0.907037,
+        "qadam": 1.162559,
+        "decentralized": 0.858617,
+        "low_precision_decentralized": 0.822391,
+        "zero": 0.175103,
+        "zero_hierarchical": 0.175103,
+    },
+}
+# modern-jax values (the line the package primarily targets; certified by
+# earlier rounds — "existing goldens re-verified unchanged")
+_GOLDENS_MODERN = {
     "gradient_allreduce": 0.888789,
     "bytegrad": 0.888740,
     "qadam": 1.180702,
@@ -29,6 +51,8 @@ GOLDENS = {
     # allreduce(inter) reassociation difference is below rounding)
     "zero_hierarchical": 0.210334,
 }
+_JAX_MINOR = ".".join(jax.__version__.split(".")[:2])
+GOLDENS = _GOLDENS_BY_JAX.get(_JAX_MINOR, _GOLDENS_MODERN)
 ASYNC_BOUND = 1.0  # async final loss is timing-dependent; must still converge
 
 

@@ -1,0 +1,112 @@
+"""Worker for elastic-launcher tests and the elastic drill.
+
+Spawned by ``bagua_tpu.distributed.run --nnodes MIN:MAX``: every attempt it
+reads the RENEGOTIATED world from env (``bagua_tpu.elastic.resize``),
+re-splits the fixed global dataset for its new rank/world, resumes from the
+checkpoint, and keeps training.  The world size legitimately changes
+between attempts — world 2 -> 1 after a node dies, 1 -> 2 when it rejoins
+— so every piece of per-rank state is derived from the env, never cached
+across restarts.
+
+Checkpoint is a replicated-state npz (every rank computes identical state;
+rank 0 writes) — the orbax cross-topology path is exercised in-process by
+tests/test_elastic.py; THIS worker targets the launcher/rendezvous
+protocol, like multinode_elastic_worker.py before it.
+
+Env knobs: BAGUA_TEST_OUT (required), BAGUA_TEST_STEPS, and
+BAGUA_TEST_STEP_DELAY (seconds per step, so a drill has time to kill and
+rejoin nodes mid-run).
+"""
+
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import bagua_tpu  # noqa: E402
+from bagua_tpu.algorithms.gradient_allreduce import GradientAllReduceAlgorithm  # noqa: E402
+from bagua_tpu.elastic.resize import ElasticContext, shard_bounds  # noqa: E402
+from bagua_tpu.models.mlp import MLP  # noqa: E402
+
+
+def main():
+    ctx = ElasticContext.from_env()
+    out_dir = os.environ["BAGUA_TEST_OUT"]
+    steps = int(os.environ.get("BAGUA_TEST_STEPS", "20"))
+    delay = float(os.environ.get("BAGUA_TEST_STEP_DELAY", "0"))
+    mesh = ctx.init_process_group()
+    assert jax.process_count() == ctx.world_size, (
+        jax.process_count(), ctx.world_size,
+    )
+    print(
+        f"elastic worker: epoch {ctx.epoch} rank {ctx.rank}/{ctx.world_size} "
+        f"(node id {ctx.node_id})", flush=True,
+    )
+
+    model = MLP(features=(16, 8))
+    teacher = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    # fixed GLOBAL dataset sized for the largest world; every world size in
+    # [min, max] re-splits the same samples so the trajectory is comparable
+    n_total = 8 * ctx.max_nnodes
+    x_global = jax.random.normal(jax.random.PRNGKey(0), (n_total, 4))
+    y_global = jnp.argmax(x_global @ teacher, -1)
+    params = model.init(jax.random.PRNGKey(2), x_global[:2])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    trainer = bagua_tpu.BaguaTrainer(
+        loss_fn, optax.sgd(0.2), GradientAllReduceAlgorithm(), mesh=mesh
+    )
+    state = trainer.init(params)
+
+    ckpt = os.path.join(out_dir, "ckpt.npz")
+    start = 0
+    if os.path.exists(ckpt):
+        with np.load(ckpt) as z:
+            start = int(z["step"]) + 1
+            saved_world = int(z["world"])
+            leaves, treedef = jax.tree.flatten(state)
+            state = jax.tree.unflatten(
+                treedef, [jnp.asarray(z[f"l{i}"]) for i in range(len(leaves))]
+            )
+        print(
+            f"resumed from checkpoint step {start - 1} "
+            f"(saved at world {saved_world}, now {ctx.world_size})",
+            flush=True,
+        )
+
+    # data-shard re-split for the renegotiated world
+    lo, hi = shard_bounds(n_total, ctx.rank, ctx.world_size)
+    batch = trainer.shard_batch(
+        {"x": np.asarray(x_global[lo:hi]), "y": np.asarray(y_global[lo:hi])}
+    )
+    for step in range(start, steps):
+        state, loss = trainer.train_step(state, batch)
+        if ctx.rank == 0:  # replicated state: one writer is enough
+            leaves = jax.tree.leaves(state)
+            arrays = {f"l{i}": np.asarray(x) for i, x in enumerate(leaves)}
+            np.savez(ckpt + ".tmp.npz", step=step, world=ctx.world_size,
+                     **arrays)
+            os.replace(ckpt + ".tmp.npz", ckpt)
+        print(f"step {step} loss {float(loss):.6f} world {ctx.world_size}",
+              flush=True)
+        if delay:
+            time.sleep(delay)
+
+    with open(os.path.join(out_dir, f"final_node{ctx.node_id}.txt"), "w") as f:
+        f.write(f"{float(loss):.6f}")
+    print(f"final_loss {float(loss):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
