@@ -189,11 +189,23 @@ class AsyncModelAverageAlgorithm(Algorithm):
     def _warm_compiles(self, trainer, params) -> None:
         """Build + compile the aux jits off the steady-state window (a cache
         hit later): at a boundary they would land inside the user's training
-        loop — several seconds of remote compile on tunneled devices."""
+        loop — several seconds of remote compile on tunneled devices.
+
+        Done-once per param avals: ``.lower().compile()`` bypasses the jit
+        cache and re-lowers every call, so without the guard each periodic
+        recalibration (``recalibrate_rounds``) re-paid three compiles on
+        unchanged shapes (ADVICE.md)."""
+        key = tuple(
+            (tuple(jnp.shape(x)), str(jnp.asarray(x).dtype))
+            for x in jax.tree.leaves(params)
+        )
+        if getattr(self, "_warmed_key", None) == key:
+            return
         self._ensure_avg_fn(trainer)
         self._snap_fn.lower(params).compile()
         self._avg_fn.lower(params).compile()
         self._combine_fn.lower(params, params, params).compile()
+        self._warmed_key = key
 
     def _apply_pending(self, state, watchdog=None, block=False):
         """Apply the in-flight round to ``state`` (caller holds the lock).

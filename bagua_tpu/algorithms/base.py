@@ -43,6 +43,12 @@ class AlgorithmContext:
     intranode: Optional[BaguaCommunicator]
     plan: BucketPlan
     world_size: int
+    #: overlap scheduler active for this compiled step (the trainer streams
+    #: per-bucket collectives via :meth:`Algorithm.reduce_bucket_grad`)
+    overlap: bool = False
+    #: target per-rank bytes of one independent ring sub-collective; None
+    #: keeps the fused psum/psum_scatter primitives (no chunking)
+    overlap_chunk_bytes: Optional[int] = None
 
     def hierarchical_allreduce(self, flat, op: ReduceOp, hierarchical: bool):
         """Hierarchical = intra-node stage then inter-node stage, the reference's
@@ -57,6 +63,52 @@ class AlgorithmContext:
             flat = self.intranode.allreduce(flat, op)
             return self.internode.allreduce(flat, op)
         return self.comm.allreduce(flat, op)
+
+    def _ring_chunks(self, numel: int, itemsize: int) -> int:
+        """Sub-collective count for one bucket under the active comm config
+        (1 = keep the fused XLA primitive).  The ONE gate for all three
+        bucket collectives, so allreduce / reduce-scatter / allgather can
+        never disagree about when the ring applies."""
+        from ..communication import ring_chunks_for
+
+        if self.overlap_chunk_bytes is None:
+            return 1
+        if len(self.comm.axes) != 1 or self.comm.nranks() <= 1:
+            return 1  # ring permutes over exactly one mesh axis
+        return ring_chunks_for(
+            numel, itemsize, self.comm.nranks(), self.overlap_chunk_bytes
+        )
+
+    def bucket_allreduce(self, flat, op: ReduceOp, hierarchical: bool):
+        """One bucket's gradient allreduce under the active comm config:
+        the chunked double-buffered ring when the overlap scheduler set a
+        chunk size (single-axis comm worlds only — hierarchical mode keeps
+        the fused tiered psums), else the fused psum path.  The serialized
+        step construction (``overlap=off``) always takes the psum path."""
+        k = self._ring_chunks(flat.shape[0], flat.dtype.itemsize)
+        if k > 1 and not hierarchical:
+            return self.comm.ring_allreduce(flat, op, num_chunks=k)
+        return self.hierarchical_allreduce(flat, op, hierarchical)
+
+    def bucket_reduce_scatter(self, flat, op: ReduceOp):
+        """One bucket's reduce-scatter (ZeRO's grad half) under the active
+        comm config; chunk layout is identical between the ring and
+        ``psum_scatter`` paths (rank r owns the r-th contiguous slice)."""
+        k = self._ring_chunks(flat.shape[0], flat.dtype.itemsize)
+        if k > 1:
+            return self.comm.ring_reduce_scatter(flat, op, num_chunks=k)
+        return self.comm.reduce_scatter(flat, op)
+
+    def bucket_allgather(self, chunk):
+        """Re-replication half of ZeRO's dance (this rank's chunk -> full
+        flat), chunked-ring under the active comm config — same gate as
+        :meth:`bucket_reduce_scatter` (sized on the full flat the chunk
+        tiles) so the pair stays layout-symmetric."""
+        k = self._ring_chunks(chunk.shape[0] * self.comm.nranks(),
+                              chunk.dtype.itemsize)
+        if k > 1:
+            return self.comm.ring_allgather(chunk, num_chunks=k)
+        return self.comm.allgather(chunk, axis=0, tiled=True)
 
 
 class Algorithm:
@@ -80,6 +132,19 @@ class Algorithm:
     bucket_alignment: int = 1
     #: Hierarchical (intra-node then inter-node) communication.
     hierarchical: bool = False
+    #: Overlap contract: when True the trainer's overlap scheduler may call
+    #: :meth:`reduce_bucket_grad` once per bucket — in gradient-readiness
+    #: order, as each bucket's accumulated gradient finalizes — instead of
+    #: the whole-tree :meth:`process_grads`, then hand the per-bucket
+    #: results to :meth:`grads_from_reduced`.  Families whose gradient comm
+    #: is not a per-bucket map (gossip weight exchanges, QAdam's momentum
+    #: pipeline) keep False and always run serialized.
+    supports_overlap: bool = False
+    #: Whether ``overlap="auto"`` may pick the overlap path for this family
+    #: (explicit ``overlap="on"`` always wins).  Set False where the
+    #: measured record (BENCH_OVERLAP.json) shows the serialized path
+    #: faster despite the family supporting the contract.
+    overlap_auto: bool = True
 
     def need_reset(self, step: int) -> bool:
         """Host-side: return True to rebuild buckets/recompile (reference
@@ -121,6 +186,40 @@ class Algorithm:
         """Gradient communication stage (runs where the reference's backward
         hooks + wait_pending_comm_ops ran)."""
         return grads, algo_state
+
+    # ---- overlap scheduler stages (supports_overlap families) -----------
+
+    def reduce_bucket_grad(self, ctx: AlgorithmContext, index: int, flat):
+        """Communicate ONE bucket's final flat gradient (traced).  The
+        trainer's overlap scheduler calls this per bucket so each
+        collective's operands are exactly that bucket's finalized gradient —
+        open dataflow XLA's latency-hiding scheduler can overlap with the
+        backward compute still producing later buckets.  Returns the
+        communicated buffer: the full reduced flat for dense families, this
+        rank's owned chunk for sharded-opt-state families."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement the overlap contract"
+        )
+
+    def grads_from_reduced(self, ctx: AlgorithmContext, reduced, grads,
+                           algo_state, step):
+        """Assemble the post-communication gradient representation from the
+        per-bucket :meth:`reduce_bucket_grad` results (the overlap path's
+        replacement for :meth:`process_grads`).  Default: unflatten the
+        reduced buckets back into the gradient tree."""
+        return ctx.plan.unflatten_tree(reduced, grads), algo_state
+
+    def process_grads_bucketed(self, ctx: AlgorithmContext, grads, params,
+                               algo_state, step):
+        """The serialized comm stage for ``supports_overlap`` families:
+        the same per-bucket reduction the overlap scheduler streams, issued
+        after the full backward — one implementation, so the two paths
+        cannot drift numerically.  Dense families alias ``process_grads``
+        to this."""
+        flats = ctx.plan.flatten_tree(grads)
+        reduced = [self.reduce_bucket_grad(ctx, i, f)
+                   for i, f in enumerate(flats)]
+        return self.grads_from_reduced(ctx, reduced, grads, algo_state, step)
 
     def process_pre_step(self, ctx: AlgorithmContext, params, algo_state, step):
         """Weight transformation after backward, before the optimizer update

@@ -19,6 +19,14 @@ from .base import Algorithm, AlgorithmContext
 
 class ByteGradAlgorithm(Algorithm):
     name = "bytegrad"
+    supports_overlap = True
+    #: measured (BENCH_OVERLAP.json, 8-dev cpu-sim mesh): the overlap
+    #: restructure was never clearly faster for the codec pipeline
+    #: (0.69-0.95x in early block runs, noise-bound under interleaved
+    #: A/B), so ``auto`` keeps bytegrad serialized; opt in with
+    #: ``overlap="on"`` (worth re-measuring on a real multi-chip ICI/DCN
+    #: mesh, where the quantize sits on the critical comm path)
+    overlap_auto = False
 
     def __init__(self, hierarchical: bool = True, average: bool = True):
         """
@@ -39,8 +47,12 @@ class ByteGradAlgorithm(Algorithm):
             decl_buckets, named_params, alignment=world_size
         )
 
-    def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
-        flats = ctx.plan.flatten_tree(grads)
+    def reduce_bucket_grad(self, ctx: AlgorithmContext, index: int, flat):
+        # the whole codec (compress → alltoall → decompress → chunk-reduce →
+        # compress → allgather → decompress) runs per bucket, so under the
+        # overlap scheduler it sits inside the overlap window: bucket i's
+        # quantize + scatter-gather can proceed while bucket i+1's gradient
+        # is still being produced by the backward
         use_hier = (
             self.hierarchical
             and ctx.internode is not None
@@ -48,18 +60,17 @@ class ByteGradAlgorithm(Algorithm):
             and ctx.internode.nranks() > 1
             and ctx.intranode.nranks() > 1
         )
-        out = []
-        for f in flats:
-            if use_hier:
-                f = ctx.intranode.allreduce(
-                    f, ReduceOp.AVG if self.average else ReduceOp.SUM
-                )
-                f = compressed_scatter_gather_allreduce(
-                    ctx.internode, f, average=self.average
-                )
-            else:
-                comm = ctx.comm
-                if comm.nranks() > 1:
-                    f = compressed_scatter_gather_allreduce(comm, f, average=self.average)
-            out.append(f)
-        return ctx.plan.unflatten_tree(out, grads), algo_state
+        if use_hier:
+            flat = ctx.intranode.allreduce(
+                flat, ReduceOp.AVG if self.average else ReduceOp.SUM
+            )
+            return compressed_scatter_gather_allreduce(
+                ctx.internode, flat, average=self.average
+            )
+        if ctx.comm.nranks() > 1:
+            return compressed_scatter_gather_allreduce(
+                ctx.comm, flat, average=self.average
+            )
+        return flat
+
+    process_grads = Algorithm.process_grads_bucketed

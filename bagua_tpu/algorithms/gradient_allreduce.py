@@ -18,6 +18,7 @@ from .base import Algorithm, AlgorithmContext
 
 class GradientAllReduceAlgorithm(Algorithm):
     name = "gradient_allreduce"
+    supports_overlap = True
 
     def __init__(
         self,
@@ -43,15 +44,14 @@ class GradientAllReduceAlgorithm(Algorithm):
         self.average = average
         self.comm_dtype = comm_dtype
 
-    def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
+    def reduce_bucket_grad(self, ctx: AlgorithmContext, index: int, flat):
         op = ReduceOp.AVG if self.average else ReduceOp.SUM
-        flats = ctx.plan.flatten_tree(grads)
-        orig_dtypes = [f.dtype for f in flats]
-        if self.comm_dtype is not None:
-            flats = [f.astype(self.comm_dtype) for f in flats]
-        flats = [
-            ctx.hierarchical_allreduce(f, op, self.hierarchical) for f in flats
-        ]
-        if self.comm_dtype is not None:
-            flats = [f.astype(d) for f, d in zip(flats, orig_dtypes)]
-        return ctx.plan.unflatten_tree(flats, grads), algo_state
+        if self.comm_dtype is None:
+            return ctx.bucket_allreduce(flat, op, self.hierarchical)
+        orig = flat.dtype
+        flat = ctx.bucket_allreduce(
+            flat.astype(self.comm_dtype), op, self.hierarchical
+        )
+        return flat.astype(orig)
+
+    process_grads = Algorithm.process_grads_bucketed

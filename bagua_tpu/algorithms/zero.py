@@ -67,6 +67,19 @@ class ZeroOptimizerAlgorithm(Algorithm):
 
     owns_optimizer = True
     sharded_opt_state = True
+    #: overlap contract (flat-resident layout only — the trainer gates on
+    #: ``_zero_flat``): the per-bucket reduce-scatter is issued inside the
+    #: overlap window and ``optimizer_update`` consumes the pre-reduced
+    #: chunks instead of running its own collective
+    supports_overlap = True
+    #: measured (BENCH_OVERLAP.json, interleaved A/B on the 8-dev cpu-sim
+    #: mesh): the overlap restructure was never clearly faster — one
+    #: controlled run measured 0.89-0.94x of serialized in every trial
+    #: (splitting the reduce-scatter away from the chunk update defeats
+    #: XLA:CPU's fusion), the rest were noise-bound — so ``auto`` keeps
+    #: ZeRO serialized there; opt in with ``overlap="on"`` (re-measure on
+    #: real ICI, where the early reduce-scatter is the point)
+    overlap_auto = False
 
     def __init__(
         self,
@@ -196,9 +209,25 @@ class ZeroOptimizerAlgorithm(Algorithm):
         — the global average with only ``1/intra`` of the bytes crossing the
         inter tier (avg-of-avgs is exact: intra rows are equal-sized)."""
         if not self._staged(ctx):
-            return ctx.comm.reduce_scatter(flat, ReduceOp.AVG)
+            # chunked ring when the overlap scheduler set a chunk size,
+            # fused psum_scatter otherwise (identical chunk layout)
+            return ctx.bucket_reduce_scatter(flat, ReduceOp.AVG)
         chunk = ctx.intranode.reduce_scatter(flat, ReduceOp.AVG)
         return ctx.internode.allreduce(chunk, ReduceOp.AVG)
+
+    # ---- overlap scheduler stages ---------------------------------------
+
+    def reduce_bucket_grad(self, ctx: AlgorithmContext, index: int, flat):
+        """One bucket's gradient comm = the averaging reduce-scatter; the
+        returned buffer is this rank's owned chunk."""
+        return self._avg_scatter(ctx, flat)
+
+    def grads_from_reduced(self, ctx: AlgorithmContext, reduced, grads,
+                           algo_state, step):
+        """Flat-resident layout only: the pre-reduced chunks ride to
+        ``optimizer_update``, which then skips its own reduce-scatter (the
+        collective was already issued inside the overlap window)."""
+        return {"chunks": tuple(reduced), "local": grads["local"]}, algo_state
 
     # ---- optimizer contract ---------------------------------------------
     #
@@ -314,7 +343,12 @@ class ZeroOptimizerAlgorithm(Algorithm):
     def _optimizer_update_flat(self, ctx: AlgorithmContext, params, grads,
                                opt_state, algo_state, step):
         shard = self._shard_comm(ctx)
-        gchunks = [self._avg_scatter(ctx, gf) for gf in grads["flats"]]
+        if "chunks" in grads:
+            # overlap path: the reduce-scatter already ran per bucket
+            # inside the overlap window (grads_from_reduced)
+            gchunks = list(grads["chunks"])
+        else:
+            gchunks = [self._avg_scatter(ctx, gf) for gf in grads["flats"]]
         if self.clip_global_norm is not None:
             # chunks across the SHARD axis tile the whole flat exactly once
             # (staged: chunks are replicated over inter, so summing over
@@ -335,8 +369,13 @@ class ZeroOptimizerAlgorithm(Algorithm):
             pchunk = optax.apply_updates(pchunk, updates)
             # re-replicate (rank chunks in rank order over the shard axis;
             # staged: every inter row gathers the identical chunks, so the
-            # result stays replicated across inter with no inter traffic)
-            new_flats.append(shard.allgather(pchunk, tiled=True))
+            # result stays replicated across inter with no inter traffic).
+            # Non-staged: the chunk-aware gather, so the ring pair stays
+            # layout-symmetric when overlap chunking is on.
+            new_flats.append(
+                ctx.bucket_allgather(pchunk) if shard is ctx.comm
+                else shard.allgather(pchunk, tiled=True)
+            )
             new_states.append(st)
         new_params = {"flats": tuple(new_flats), "local": params["local"]}
         return new_params, {"buckets": tuple(new_states),

@@ -76,6 +76,12 @@ class BaguaCheckpointManager:
             enable_async_checkpointing=async_save,
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._async_save = bool(async_save)
+        # layout sidecars whose orbax save is not yet known-durable:
+        # written only once the async save finishes (wait()/close()/next
+        # save), so a crash mid-save can't leave a sidecar pointing at a
+        # checkpoint that never became readable (ADVICE.md)
+        self._pending_layouts: dict = {}
         _LIVE_MANAGERS.add(self)
 
     def save(self, step: int, state: Any, metadata: Optional[dict] = None) -> bool:
@@ -92,17 +98,57 @@ class BaguaCheckpointManager:
         an orbax item: orbax locks a manager to one item structure on first
         use, so a composite item would make mixing metadata and plain saves
         (or resuming an old checkpoint, then saving) an opaque error.  The
-        state's on-disk format is identical with and without metadata."""
+        state's on-disk format is identical with and without metadata.
+
+        Async saves defer the sidecar write until the orbax save is
+        DURABLE: orbax finalizes the previous async save before starting a
+        new one, so the pending sidecar flushes at the next :meth:`save`,
+        or in :meth:`wait`/:meth:`close` — never ahead of its checkpoint."""
         saved = self._mgr.save(
             int(step), args=self._ocp.args.StandardSave(state)
         )
-        if saved and metadata is not None and jax.process_index() == 0:
-            import json
-
-            path = self._layout_path(step)
-            path.write_text(json.dumps(metadata))
-            self._prune_layout_sidecars()
+        if saved:
+            # orbax finalizes the PREVIOUS async save inside a proceeding
+            # _mgr.save() (its internal wait_until_finished runs after the
+            # should_save early-return), so only a save that actually
+            # proceeded proves the stashed sidecars point at durable
+            # checkpoints — flushing on a skipped save would reopen the
+            # crash window this deferral exists to close
+            self._flush_pending_layouts()
+        if saved and metadata is not None:
+            if self._async_save:
+                # stashed on EVERY process (written by process 0 only):
+                # a restore of a not-yet-flushed step must see the same
+                # metadata on all processes, or a layout mismatch would
+                # raise on process 0 alone and strand the others in the
+                # collective orbax restore
+                self._pending_layouts[int(step)] = metadata
+            else:
+                self._write_layout(int(step), metadata)
         return saved
+
+    def _write_layout(self, step: int, metadata: dict) -> None:
+        import json
+
+        if jax.process_index() != 0:
+            return
+        self._layout_path(step).write_text(json.dumps(metadata))
+        self._prune_layout_sidecars()
+
+    def _flush_pending_layouts(self) -> None:
+        """Write sidecars whose orbax save has since become durable.  Call
+        only at points where queued async saves are known finished (after
+        ``wait_until_finished``, or after the next proceeding ``save``).
+        Entries are dropped only on a successful write — a transient
+        shared-fs error keeps the stash so wait()/close()/the next save
+        retry it."""
+        for step in list(self._pending_layouts):
+            try:
+                self._write_layout(step, self._pending_layouts[step])
+                del self._pending_layouts[step]
+            except Exception as e:  # pragma: no cover - fs-backend dependent
+                logger.warning("layout sidecar write failed for step %s "
+                               "(kept for retry): %s", step, e)
 
     def _prune_layout_sidecars(self) -> None:
         """Best-effort: drop sidecars for steps orbax retention has pruned."""
@@ -128,6 +174,10 @@ class BaguaCheckpointManager:
     def _read_layout(self, step: int) -> Optional[dict]:
         import json
 
+        if int(step) in self._pending_layouts:
+            # restoring a step whose async save hasn't been waited on yet:
+            # the stashed metadata is authoritative
+            return self._pending_layouts[int(step)]
         path = self._layout_path(step)
         if not path.exists():
             return None
@@ -272,9 +322,12 @@ class BaguaCheckpointManager:
         )
 
     def wait(self) -> None:
-        """Block until queued async saves are durable."""
+        """Block until queued async saves are durable, then write their
+        deferred layout sidecars."""
         self._mgr.wait_until_finished()
+        self._flush_pending_layouts()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_pending_layouts()
         self._mgr.close()

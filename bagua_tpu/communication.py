@@ -214,6 +214,149 @@ class BaguaCommunicator:
             raise ValueError("ppermute needs a single mesh axis")
         return lax.ppermute(x, self.axes[0], perm=list(perm))
 
+    # -- chunked ring collectives (overlap scheduler, ISSUE 2) -------------
+    #
+    # ``psum``/``psum_scatter`` hand XLA ONE monolithic collective per
+    # bucket: the latency-hiding scheduler can overlap it with unrelated
+    # compute, but cannot start reducing a bucket's early bytes while its
+    # late bytes are still being produced, nor interleave two phases of the
+    # same bucket.  The ring forms below decompose a bucket into
+    # ``num_chunks`` INDEPENDENT sub-collectives built from ``ppermute``
+    # hops + local adds — double-buffered in the sense that chunk ``c+1``'s
+    # local adds are free to run while chunk ``c``'s hop is on the wire.
+    # Chunk layout matches the tiled ``psum_scatter``/``all_gather`` pair
+    # exactly (rank r owns the r-th CONTIGUOUS slice), so ZeRO's
+    # reduce-scatter → update → all-gather dance can swap primitives
+    # without relayouting its optimizer-state chunks.
+
+    def _ring_valid(self) -> bool:
+        """Ring forms need a single nontrivial mesh axis to permute over."""
+        return len(self.axes) == 1 and self.nranks() > 1
+
+    def _ring_blocks(self, x, n):
+        """[n*m, ...] -> per-rank-block view [n, m, ...] plus a traced
+        block selector (dynamic_slice: block index depends on the rank)."""
+        assert x.shape[0] % n == 0, (x.shape, n)
+        blocks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+
+        def block(i):
+            return jnp.squeeze(
+                lax.dynamic_slice_in_dim(blocks, i % n, 1, axis=0), 0
+            )
+
+        return blocks, block
+
+    def _ring_reduce_scatter_1(self, x, op: ReduceOp):
+        """One ring: rank r ends with the reduction of every rank's r-th
+        block.  The partial sum for block b starts at rank ``(b+1) % n`` and
+        travels +1 per hop, each rank adding its own contribution — n-1
+        ``ppermute`` hops, each moving 1/n of the bytes (bandwidth-optimal,
+        like NCCL's ring)."""
+        n = self.nranks()
+        if op not in (ReduceOp.SUM, ReduceOp.AVG):
+            raise ValueError(f"ring reduce_scatter supports SUM/AVG, got {op}")
+        r = self.rank()
+        _, block = self._ring_blocks(x, n)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        buf = block(r - 1)
+        # unrolled: every hop is its own ppermute instruction, so the
+        # scheduler may pipeline hop s+1's local add under hop s's wire time
+        for s in range(n - 1):
+            buf = self.ppermute(buf, perm)
+            buf = buf + block(r - 2 - s)
+        if op == ReduceOp.AVG:
+            buf = buf / n
+        return buf
+
+    def _ring_allgather_1(self, x):
+        """One ring: input is this rank's block, output is all blocks in
+        rank order (``[n * m, ...]``) — the inverse of
+        :meth:`_ring_reduce_scatter_1`'s ownership layout."""
+        n = self.nranks()
+        r = self.rank()
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        out = jnp.zeros((n,) + x.shape, x.dtype)
+        out = lax.dynamic_update_slice_in_dim(out, x[None], r % n, axis=0)
+        buf = x
+        for s in range(n - 1):
+            buf = self.ppermute(buf, perm)
+            out = lax.dynamic_update_slice_in_dim(
+                out, buf[None], (r - 1 - s) % n, axis=0
+            )
+        return out.reshape((n * x.shape[0],) + x.shape[1:])
+
+    def _ring_chunk_views(self, x, num_chunks: int, n: int):
+        """Split flat ``x`` into ``num_chunks`` independent sub-buffers such
+        that concatenating each rank's sub-results reproduces the CONTIGUOUS
+        per-rank chunk layout: sub-chunk j is the concatenation over ranks of
+        each rank-block's j-th slice (``x.reshape(n, k, -1)[:, j]``)."""
+        m = x.shape[0] // n
+        assert m % num_chunks == 0, (m, num_chunks)
+        view = x.reshape(n, num_chunks, m // num_chunks)
+        return [view[:, j].reshape(-1) for j in range(num_chunks)]
+
+    def ring_reduce_scatter(self, x, op: ReduceOp = ReduceOp.SUM,
+                            num_chunks: int = 1):
+        """Chunked ring reduce-scatter of flat ``x`` (``size % nranks == 0``;
+        ``num_chunks`` must divide the per-rank block).  Returns this rank's
+        contiguous slice — same layout as ``reduce_scatter(..., tiled)``."""
+        if not self._ring_valid():
+            return self.reduce_scatter(x, op)
+        n = self.nranks()
+        if num_chunks <= 1:
+            parts = [x]
+        else:
+            parts = self._ring_chunk_views(x, num_chunks, n)
+        outs = [self._ring_reduce_scatter_1(p, op) for p in parts]
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+    def ring_allgather(self, x, num_chunks: int = 1):
+        """Chunked ring all-gather of this rank's flat chunk; inverse of
+        :meth:`ring_reduce_scatter` (``[m] -> [nranks * m]`` in rank
+        order)."""
+        if not self._ring_valid():
+            return self.allgather(x, axis=0, tiled=True)
+        n = self.nranks()
+        if num_chunks <= 1:
+            return self._ring_allgather_1(x)
+        mk = x.shape[0] // num_chunks
+        subs = x.reshape(num_chunks, mk)
+        gathered = [self._ring_allgather_1(subs[j]) for j in range(num_chunks)]
+        out = jnp.stack([g.reshape(n, mk) for g in gathered], axis=1)
+        return out.reshape(n * x.shape[0])
+
+    def ring_allreduce(self, x, op: ReduceOp = ReduceOp.AVG,
+                       num_chunks: int = 1):
+        """Chunked double-buffered ring allreduce: reduce-scatter ring then
+        all-gather ring per chunk.  Wire bytes equal the monolithic
+        allreduce's ring model (``2(n-1)/n`` of the buffer); what changes is
+        schedulability — ``num_chunks`` independent chains the
+        latency-hiding scheduler can interleave with compute and each
+        other.  Buffers that don't split evenly are zero-padded internally
+        (sound for SUM/AVG) and sliced back — unlike the scatter/gather
+        pair, whose ownership layout forbids silent padding."""
+        if not self._ring_valid():
+            return self.allreduce(x, op)
+        n = self.nranks()
+        size = x.shape[0]
+        pad = (-size) % (n * max(1, num_chunks))
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+        if num_chunks <= 1:
+            out = self._ring_allgather_1(self._ring_reduce_scatter_1(x, op))
+            return out[:size] if pad else out
+        parts = self._ring_chunk_views(x, num_chunks, n)
+        outs = [
+            self._ring_allgather_1(self._ring_reduce_scatter_1(p, op))
+            for p in parts
+        ]
+        # each sub-result is [n, m/num_chunks] in rank order; re-interleave
+        # back to the original flat element order
+        mk = parts[0].shape[0] // n
+        out = jnp.stack([o.reshape(n, mk) for o in outs], axis=1)
+        out = out.reshape(x.shape)
+        return out[:size] if pad else out
+
     def broadcast(self, x, src: int = 0):
         """Every rank gets rank ``src``'s value (reference broadcast
         communication.py:270-300)."""
@@ -272,6 +415,29 @@ class BaguaCommunicator:
         """Device-level barrier: a tiny psum over the axes (reference
         communicators/mod.rs:973-982 uses a 1-element allreduce too)."""
         return lax.psum(jnp.ones((), jnp.int32), self.axes)
+
+
+#: compile-size guard for the chunked rings (see :func:`ring_chunks_for`)
+MAX_RING_CHUNKS = int(os.environ.get("BAGUA_MAX_RING_CHUNKS", "32"))
+
+
+def ring_chunks_for(numel: int, itemsize: int, nranks: int,
+                    chunk_bytes: Optional[int]) -> int:
+    """Host-side sizing for the chunked ring collectives: the number of
+    independent sub-collectives such that each carries ~``chunk_bytes`` of
+    this rank's payload per hop (``ring_allreduce`` zero-pads indivisible
+    buffers, so the per-rank block is the padded one).  1 = monolithic."""
+    if not chunk_bytes or nranks <= 1:
+        return 1
+    m = -(-numel // nranks)  # per-rank block after the ring's padding
+    k = max(1, int(round(m * itemsize / chunk_bytes)))
+    # each sub-ring unrolls into 2(n-1) ppermute instructions, so k is
+    # capped: a tiny chunk_bytes against a 10 MiB bucket would otherwise
+    # emit thousands of collectives per bucket and stall/OOM the compiler
+    k = min(k, m, MAX_RING_CHUNKS)
+    while m % k:  # num_chunks must divide the per-rank block
+        k -= 1
+    return k
 
 
 class BaguaBackend:

@@ -67,12 +67,14 @@ def decompress_chunked(mn: jax.Array, mx: jax.Array, payload: jax.Array) -> jax.
 
 
 # measured crossover (BENCH_r05 kernel-level codec profile, v5e): the fused
-# Pallas compress beats the XLA lowering from ~1 MB f32 chunks up (+9% kernel
+# Pallas compress beats the XLA lowering from ~1 MiB chunks up (+9% kernel
 # time) but LOSES below (grid/dispatch overhead dominates at 128 KB chunks);
 # jnp decompress (one elementwise map, fully fused by XLA) beat the Pallas
-# decompress at every measured size.  Chunks at/above this many f32 elems
-# take the Pallas compress.
-_PALLAS_MIN_CHUNK_ELEMS = 1 << 18  # 1 MiB of f32
+# decompress at every measured size.  The crossover is BYTE-based (it is
+# grid/dispatch overhead vs bytes streamed), so the gate scales by the
+# input itemsize — a bf16/f16 flat must reach the same 1 MiB of payload,
+# not half of it, before the Pallas path pays off (ADVICE.md).
+_PALLAS_MIN_CHUNK_BYTES = 1 << 20  # 1 MiB
 
 
 def _codec(comm: BaguaCommunicator):
@@ -88,7 +90,7 @@ def _codec(comm: BaguaCommunicator):
         from .pallas_codec import compress_chunked_pallas
 
         def compress(v, n):
-            if v.size // n >= _PALLAS_MIN_CHUNK_ELEMS:
+            if (v.size // n) * v.dtype.itemsize >= _PALLAS_MIN_CHUNK_BYTES:
                 return compress_chunked_pallas(v, n)
             return compress_chunked(v, n)
 

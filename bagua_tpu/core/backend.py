@@ -96,6 +96,8 @@ class BaguaTrainer:
         pp_axis: Optional[str] = None,
         pp_param_dim=None,
         accum_steps: int = 1,
+        overlap: Optional[str] = None,
+        overlap_chunk_bytes: Optional[int] = None,
     ):
         """``expert_axis``: mesh axis carrying expert parallelism (MoE).
         Expert params are sharded over it and excluded from the data-parallel
@@ -144,7 +146,31 @@ class BaguaTrainer:
         microbatches (``lax.scan``, so the backward is compiled once),
         averaging losses and gradients before any algorithm stage runs —
         communication still happens once per step, on the accumulated
-        gradient, exactly as if the full batch had fit in memory."""
+        gradient, exactly as if the full batch had fit in memory — unless
+        the overlap scheduler restructures the scan (below).
+
+        ``overlap``: the overlap-aware bucket communication scheduler
+        (Bagua's core thesis, arXiv 2107.01499: the wins come from WHEN you
+        communicate).  ``"off"`` keeps the exact serialized step
+        construction — every collective after the full backward/scan.
+        ``"on"`` streams per-bucket collectives into compute: with
+        ``accum_steps > 1`` the last microbatch is peeled out of the scan
+        (bit-identical gradient sum order) so each bucket's collective is
+        issued as soon as its accumulated gradient finalizes, overlapping
+        with the remaining backward; buckets are re-ordered by observed
+        gradient readiness (one-time, host-side) so the first-finalized
+        bucket heads the comm sequence.  ``"auto"`` (default, or env
+        ``BAGUA_OVERLAP``) resolves to whichever path measured faster —
+        see BENCH_OVERLAP.json.  Supported families: gradient_allreduce,
+        bytegrad, and flat-resident ZeRO; others always run serialized.
+
+        ``overlap_chunk_bytes``: target per-rank bytes of one independent
+        chunked-ring sub-collective (``communication.ring_allreduce``), so
+        even the ``accum_steps == 1`` path exposes multiple independent
+        collectives the latency-hiding scheduler can interleave.  Default
+        0 / env ``BAGUA_OVERLAP_CHUNK_BYTES``: keep the fused XLA
+        collectives.  Only applies while the overlap scheduler is active,
+        on single-axis comm worlds."""
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.algorithm = algorithm
@@ -230,6 +256,14 @@ class BaguaTrainer:
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.accum_steps = int(accum_steps)
+        self.overlap = (overlap or env.get_overlap_mode()).strip().lower()
+        if self.overlap not in ("auto", "on", "off"):
+            raise ValueError(f"overlap must be auto|on|off, got {overlap!r}")
+        self.overlap_chunk_bytes = int(
+            env.get_overlap_chunk_bytes() if overlap_chunk_bytes is None
+            else overlap_chunk_bytes
+        )
+        self._overlap_ordered = False
         self.bucket_bytes = bucket_bytes or env.get_default_bucket_size()
         self.model_name = model_name
         self.donate = donate
@@ -286,14 +320,77 @@ class BaguaTrainer:
 
     # ---- plan management -----------------------------------------------
 
-    def _ctx(self, plan: BucketPlan) -> AlgorithmContext:
+    def _ctx(self, plan: BucketPlan, overlap: bool = False) -> AlgorithmContext:
         return AlgorithmContext(
             comm=self._comm,
             internode=self._inter,
             intranode=self._intra,
             plan=plan,
             world_size=self.world_size,
+            overlap=overlap,
+            overlap_chunk_bytes=(
+                self.overlap_chunk_bytes or None if overlap else None
+            ),
         )
+
+    def _overlap_active(self) -> bool:
+        """Dispatch gate for the overlap scheduler.  Explicit on/off wins;
+        ``auto`` resolves to the path that measured faster
+        (BENCH_OVERLAP.json): overlap when there is an accumulation scan to
+        stream collectives into (the peel is bit-exact and measured
+        fastest), the serialized construction otherwise — at
+        ``accum_steps == 1`` the backward already feeds the per-bucket
+        collectives as open dataflow, so restructuring buys nothing unless
+        ring chunking is explicitly requested."""
+        if not self.algorithm.supports_overlap:
+            return False
+        if self.algorithm.sharded_opt_state and not self._zero_flat:
+            # ZeRO overlap rides the flat-resident (pure-dp) layout only:
+            # the leaf layout's comm happens inside optimizer_update after
+            # the leaf->flat round trip, outside the overlap window
+            return False
+        if self.overlap == "off":
+            return False
+        if self.overlap == "on":
+            return True
+        # auto: measured dispatch gate (BENCH_OVERLAP.json, interleaved A/B
+        # trials on the 8-dev cpu-sim mesh): allreduce measured on-par-to-
+        # faster under overlap at accum>1 (best-trial 1.03x, noise-bound) —
+        # and the peel is bit-exact, so auto takes it; ZeRO and bytegrad
+        # measured slower (0.9x / 0.99x → overlap_auto=False on those
+        # families, overridable with overlap="on").  accum==1 keeps the
+        # serialized construction (the backward already feeds the bucket
+        # collectives as open dataflow); an explicit chunk size is an
+        # opt-in to the ring path at any accum.
+        return self.algorithm.overlap_auto and (
+            self.accum_steps > 1 or bool(self.overlap_chunk_bytes)
+        )
+
+    def _reorder_plan_for_overlap(self, state, batch) -> None:
+        """One-time host-side re-bucketing by observed gradient readiness
+        (reverse execution order) so the overlap scheduler's first-issued
+        collective is the first-finalized bucket — the trainer-local analog
+        of the autotune service's span-driven re-ordering
+        (:meth:`_report_tensor_execution_order`), for runs without the
+        sidecar.  Static jaxpr analysis, no compiles; never takes down
+        training."""
+        try:
+            from ..telemetry import profile_tensor_execution_order
+
+            params = self.unstack_params(state)
+            spans = profile_tensor_execution_order(self.loss_fn, params, batch)
+            order = {s["tensor_name"]: i for i, s in enumerate(spans)}
+            decls = [t.declaration() for b in self._plan.buckets
+                     for t in b.tensors]
+            n = len(order)
+            decls.sort(key=lambda d: order.get(d.name, n))
+            self.rebucket(split_bucket_by_bucket_size(decls, self.bucket_bytes))
+            logger.info(
+                "overlap: re-bucketed %d tensors by gradient readiness "
+                "(%d buckets)", len(decls), len(self._plan.buckets),
+            )
+        except Exception as e:
+            logger.warning("overlap readiness re-bucketing skipped: %s", e)
 
     @staticmethod
     def _make_expert_filter(expert_params, expert_keyword):
@@ -609,7 +706,8 @@ class BaguaTrainer:
 
     def _make_step_fn(self, plan: BucketPlan):
         algo = self.algorithm
-        ctx = self._ctx(plan)
+        overlap = self._overlap_active()
+        ctx = self._ctx(plan, overlap=overlap)
         mesh = self.mesh
         dp = self.dp_axes
         replicated = algo.replicated_params
@@ -681,7 +779,26 @@ class BaguaTrainer:
                     jnp.zeros((), loss_dtype),
                     jax.tree.map(jnp.zeros_like, params),
                 )
-                (loss, grads), _ = jax.lax.scan(micro_step, zero, microbatches)
+                if overlap:
+                    # Overlap scheduler: peel the LAST microbatch out of
+                    # the scan.  A scan is one opaque while-op whose
+                    # results exist only at loop exit, so every collective
+                    # must wait for the whole scan; with the tail peeled,
+                    # the final backward is open dataflow — each bucket's
+                    # accumulated gradient (carry + tail grad, elementwise)
+                    # finalizes as the backward produces that bucket's
+                    # leaves, and its collective (issued below) can run
+                    # while later buckets are still being computed.  The
+                    # gradient sum order is unchanged, so the peeled and
+                    # scanned constructions are bit-identical.
+                    head = jax.tree.map(lambda x: x[:-1], microbatches)
+                    tail = jax.tree.map(lambda x: x[-1], microbatches)
+                    (loss, grads), _ = jax.lax.scan(micro_step, zero, head)
+                    (loss, grads), _ = micro_step((loss, grads), tail)
+                else:
+                    (loss, grads), _ = jax.lax.scan(
+                        micro_step, zero, microbatches
+                    )
                 loss = loss / accum
                 grads = jax.tree.map(lambda g: g / accum, grads)
             else:
@@ -698,7 +815,27 @@ class BaguaTrainer:
                     return g * pp_size
 
                 grads = jax.tree_util.tree_map_with_path(pp_dense_grad, grads)
-            grads, algo_state = algo.process_grads(ctx, grads, params, algo_state, step)
+            if overlap:
+                # streamed comm stage: one collective per bucket, issued in
+                # bucket (readiness) order on exactly that bucket's
+                # finalized gradient — the algorithm families plug in via
+                # reduce_bucket_grad (allreduce, bytegrad's codec pipeline,
+                # ZeRO's reduce-scatter all ride the same machinery)
+                if self._zero_flat:
+                    # flat-resident grads are already the bucket flats
+                    reduced = [algo.reduce_bucket_grad(ctx, i, f)
+                               for i, f in enumerate(grads["flats"])]
+                    grads, algo_state = algo.grads_from_reduced(
+                        ctx, reduced, grads, algo_state, step
+                    )
+                else:
+                    grads, algo_state = algo.process_grads_bucketed(
+                        ctx, grads, params, algo_state, step
+                    )
+            else:
+                grads, algo_state = algo.process_grads(
+                    ctx, grads, params, algo_state, step
+                )
             if expert is not None:
                 # Expert grads bypass the bucket plan.  The all_to_all
                 # backward already SUMS every ep shard's loss contribution
@@ -800,11 +937,19 @@ class BaguaTrainer:
         return tree_from_named(self._param_template, named)
 
     def _get_step_fn(self):
+        overlap = self._overlap_active()
         key = (
             self._plan.signature(),
             self._phase,
             self.algorithm.hierarchical,
             type(self.algorithm).__name__,
+            overlap,
+            # chunk bytes only reach the traced program while overlap is
+            # active (_ctx nulls them otherwise) — keying the raw value
+            # would recompile bit-identical serialized steps
+            self.overlap_chunk_bytes if overlap else 0,
+            # compile_key stays LAST: introspection (tests, debugging)
+            # reads it as key[-1]
             self.algorithm.compile_key(),
         )
         if key not in self._step_cache:
@@ -847,6 +992,21 @@ class BaguaTrainer:
             and env.get_autotune_level() >= 2
         ):
             self._report_tensor_execution_order(state, batch)
+        if (
+            not self._overlap_ordered
+            and self._overlap_active()
+            and not self.algorithm.sharded_opt_state
+            and not self.autotune
+        ):
+            # one-time readiness re-bucketing (reverse execution order);
+            # skipped under autotune — its recommendation path owns bucket
+            # order there (span-driven, _report_tensor_execution_order) and
+            # a trainer-local re-split would discard the recommended
+            # boundaries — and for sharded-opt-state families, whose chunk
+            # states are keyed on bucket boundaries (rebucket would orphan
+            # them)
+            self._overlap_ordered = True
+            self._reorder_plan_for_overlap(state, batch)
         fn = self._get_step_fn()
         out = fn(state, batch)
         if self._watchdog is not None:
@@ -960,7 +1120,7 @@ class BaguaTrainer:
         self._get_step_fn()
         key = (self._plan.signature(), self._phase,
                self.algorithm.hierarchical, type(self.algorithm).__name__,
-               self.algorithm.compile_key())
+               self.algorithm.compile_key())  # eval has no comm-stage overlap
         if getattr(self, "_eval_key", None) != key:
             self._eval_fn = self._make_eval_fn(self._state_specs,
                                                self._batch_spec())
@@ -1025,6 +1185,13 @@ class BaguaTrainer:
 
     def _apply_recommendation(self, recommended) -> None:
         self._maybe_switch_algorithm(recommended)
+        # overlap knobs ride the same recommendation path as bucketing so
+        # the two compose: a re-bucketed plan keeps the overlap mode, and
+        # an overlap flip recompiles via the step-cache key
+        if recommended.overlap in ("auto", "on", "off"):
+            self.overlap = recommended.overlap
+        if recommended.overlap_chunk_bytes:
+            self.overlap_chunk_bytes = int(recommended.overlap_chunk_bytes)
         if recommended.buckets:
             named_by_name = {p.name: p for p in self._named_params}
             decl_buckets = [
@@ -1204,6 +1371,8 @@ class BaguaTrainer:
             buckets=[[TensorDeclaration(**d) for d in b] for b in buckets],
             is_hierarchical_reduce=bool(self.algorithm.hierarchical),
             bucket_size=self.bucket_bytes,
+            overlap=self.overlap,
+            overlap_chunk_bytes=int(self.overlap_chunk_bytes),
         )
 
     def _batch_spec(self) -> P:

@@ -60,6 +60,12 @@ class BaguaHyperparameter(BaseModel):
     #: TPU extension over the reference — BASELINE.json requires the
     #: centralized/decentralized/low-precision families to be selectable
     algorithm: str = ""
+    #: overlap-scheduler dispatch gate ("auto"|"on"|"off"; "" = keep
+    #: current) — rides the recommendation path so re-bucketing and
+    #: overlap tuning compose (TPU extension, ISSUE 2)
+    overlap: str = ""
+    #: chunked-ring sub-collective size in bytes (0 = keep current)
+    overlap_chunk_bytes: int = 0
 
     def update(self, param_dict: dict) -> "BaguaHyperparameter":
         tmp = self.model_dump()

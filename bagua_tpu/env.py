@@ -66,6 +66,22 @@ def get_default_bucket_size() -> int:
     return _int_env("BAGUA_DEFAULT_BUCKET_SIZE", 10 * 1024 ** 2)
 
 
+def get_overlap_mode() -> str:
+    """Overlap-scheduler dispatch gate: ``auto`` (default — the path that
+    measured faster, see BENCH_OVERLAP.json), ``on``, or ``off`` (the exact
+    serialized step construction)."""
+    v = os.environ.get("BAGUA_OVERLAP", "auto").strip().lower() or "auto"
+    if v not in ("auto", "on", "off"):
+        raise ValueError(f"BAGUA_OVERLAP must be auto|on|off, got {v!r}")
+    return v
+
+
+def get_overlap_chunk_bytes() -> int:
+    """Target per-rank bytes of one independent ring sub-collective under
+    the overlap scheduler; 0 (default) keeps the fused XLA collectives."""
+    return _int_env("BAGUA_OVERLAP_CHUNK_BYTES", 0)
+
+
 def get_bagua_service_port() -> int:
     return _int_env("BAGUA_SERVICE_PORT", -1)
 

@@ -277,6 +277,103 @@ def parse_xplane_op_profile(xplane_path: str) -> dict:
     }
 
 
+#: HLO instruction-name prefixes that put an op on the wire (ICI/DCN) —
+#: async collectives appear as ``<name>-start``/``-done``, which the
+#: prefix match also covers
+_COMM_OP_PREFIXES = (
+    "all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+    "collective-permute", "ragged-all-to-all", "collective-broadcast",
+)
+
+
+def is_comm_op(name: str) -> bool:
+    return name.startswith(_COMM_OP_PREFIXES)
+
+
+def parse_xplane_overlap(xplane_path: str) -> dict:
+    """Profiler-derived comm-hidden ratio for the overlap scheduler's bench
+    record (ISSUE 2): from the first TPU plane's ``XLA Ops`` line, sum
+    on-device time of communication ops (:func:`is_comm_op`) vs everything
+    else, against the device step wall (``Steps`` line).
+
+    If comm and compute ran strictly serialized, ``step ≈ comm + compute``;
+    every second below that is a second of communication the scheduler hid
+    under compute::
+
+        overlap_fraction = clamp((comm + compute - step) / comm, 0, 1)
+
+    Returns ``{}`` off-TPU or when the trace lacks the needed lines —
+    callers record ``overlap_fraction: null`` honestly instead of guessing.
+    """
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
+
+    xs = xplane_pb2.XSpace()
+    with open(xplane_path, "rb") as f:
+        xs.ParseFromString(f.read())
+    plane = next(
+        (p for p in xs.planes if p.name.startswith("/device:TPU")), None
+    )
+    if plane is None:
+        return {}
+    emd = plane.event_metadata
+    comm_ps = 0
+    compute_ps = 0
+    n_steps = 0
+    step_ps = 0
+    for line in plane.lines:
+        if line.name == "Steps":
+            n_steps = len(line.events)
+            step_ps = sum(e.duration_ps for e in line.events)
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            if is_comm_op(emd[ev.metadata_id].name):
+                comm_ps += ev.duration_ps
+            else:
+                compute_ps += ev.duration_ps
+    if not n_steps or not step_ps or not comm_ps:
+        return {}
+    step_s = step_ps / n_steps / 1e12
+    comm_s = comm_ps / n_steps / 1e12
+    compute_s = compute_ps / n_steps / 1e12
+    hidden = max(0.0, min(1.0, (comm_s + compute_s - step_s) / comm_s))
+    return {
+        "step_s": round(step_s, 6),
+        "comm_s_per_step": round(comm_s, 6),
+        "compute_s_per_step": round(compute_s, 6),
+        "overlap_fraction": round(hidden, 3),
+    }
+
+
+def trace_overlap(run_step, steps: int = 5, finalize=None) -> dict:
+    """Run ``run_step()`` under a trace and return
+    :func:`parse_xplane_overlap`'s fields ({} off-TPU).  Same enqueue-only
+    contract as :func:`trace_memory_traffic`."""
+    import glob
+    import shutil
+    import tempfile
+
+    import jax
+
+    d = tempfile.mkdtemp(prefix="bagua_overlap_trace_")
+    try:
+        with jax.profiler.trace(d):
+            for _ in range(steps):
+                run_step()
+            if finalize is not None:
+                finalize()
+        files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
+        if not files:
+            return {}
+        try:
+            return parse_xplane_overlap(files[-1])
+        except Exception as e:  # pragma: no cover - proto availability varies
+            logger.info("xplane parse unavailable: %s", e)
+            return {}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def parse_xplane_memory_traffic(xplane_path: str) -> dict:
     """Aggregate per-op ``memory_access_breakdown`` over every executed op
     occurrence in the TPU device plane.  Memory spaces (op_metrics.proto

@@ -118,10 +118,11 @@ class AutotuneTaskManager:
                 )
             self.optimizer.tell(point, last_score)
         nxt = self.optimizer.ask()
-        return self._materialize(nxt, tensor_list)
+        return self._materialize(nxt, tensor_list, last_hp)
 
     def _materialize(
-        self, point: Dict, tensor_list: List[TensorDeclaration]
+        self, point: Dict, tensor_list: List[TensorDeclaration],
+        last_hp: Optional[BaguaHyperparameter] = None,
     ) -> BaguaHyperparameter:
         bucket_size = 2 ** point["bucket_size_2p"]
         ordered = self._order_tensors(tensor_list)
@@ -132,6 +133,13 @@ class AutotuneTaskManager:
             algorithm=(
                 ALGORITHM_FAMILIES[point["algorithm_index"]]
                 if self.tune_algorithm else ""
+            ),
+            # overlap knobs are carried through, not searched: the trainer's
+            # reported values survive re-bucketing recommendations ("" / 0
+            # means "keep current" on the trainer side either way)
+            overlap=(last_hp.overlap if last_hp is not None else ""),
+            overlap_chunk_bytes=(
+                last_hp.overlap_chunk_bytes if last_hp is not None else 0
             ),
         )
 

@@ -448,21 +448,22 @@ def bench_bert(mesh, n_dev: int, batch_per_chip: int = BERT_BATCH_PER_CHIP,
     except Exception as e:  # noqa: BLE001 - tracing must not lose a record
         print(f"# measured-memory trace failed: {e}", flush=True)
     seq_per_sec = 10 * batch / dt
-    # Baseline (VERDICT r4 #4): the reference publishes BERT-Large finetune
-    # results only as epoch-time charts (README.md:31-36) and paper scaling
-    # curves (arXiv 2107.01499) — no absolute 8xV100 seq/s figure survives
-    # in its repo, so the defensible anchor is MFU-PARITY: grant an AMP
-    # V100 (125 TFLOP/s tensor peak) the SAME model-FLOPs utilization this
-    # chip measures for the identical config.  baseline_per_gpu =
-    # 125e12 * mfu / flops_per_seq; vs_baseline then reduces to the silicon
-    # peak ratio — deliberately generous to the V100, whose published
-    # BERT-Large AMP utilization is below what this chip measures here.
-    vs = None
+    # Baseline accounting (VERDICT r4 #4, ADVICE): the reference publishes
+    # BERT-Large finetune results only as epoch-time charts (README.md:
+    # 31-36) and paper scaling curves (arXiv 2107.01499) — no absolute
+    # 8xV100 seq/s figure survives in its repo.  An MFU-PARITY grant
+    # (give an AMP V100's 125 TFLOP/s peak the SAME utilization this chip
+    # measures) algebraically CANCELS the measured MFU:
+    # seq_per_sec / baseline == chip_peak / V100_peak — a constant silicon
+    # ratio, not a measured comparison.  It is therefore reported as
+    # ``peak_flops_ratio`` and ``vs_baseline`` stays null so JSON readers
+    # don't mistake silicon for measurement.
+    peak_ratio = None
     baseline = None
     if perf.get("mfu") and perf.get("tflops_achieved"):
         flops_per_seq = perf["tflops_achieved"] * 1e12 / seq_per_sec
         baseline = BERT_V100_PEAK_TFLOPS * 1e12 * perf["mfu"] / flops_per_seq
-        vs = round(seq_per_sec / baseline, 3)
+        peak_ratio = round(seq_per_sec / baseline, 3)
     suffix = (f"_b{batch_per_chip}"
               if suffix_config and batch_per_chip != BERT_BATCH_PER_CHIP
               else "")
@@ -471,10 +472,12 @@ def bench_bert(mesh, n_dev: int, batch_per_chip: int = BERT_BATCH_PER_CHIP,
         "value": round(seq_per_sec, 2),
         "unit": "seq/s",
         "batch_per_chip": batch_per_chip,
-        "vs_baseline": vs,
-        "baseline_per_gpu_seq_s": round(baseline, 2) if baseline else None,
-        "baseline_method": "MFU-parity vs 125 TFLOP/s AMP V100 "
-                           "(equal-utilization grant; see bench_bert)",
+        "vs_baseline": None,
+        "baseline_rationale": "no measured reference baseline survives; "
+                              "peak_flops_ratio is the MFU-parity identity "
+                              "(chip peak / V100 peak), a silicon ratio",
+        "peak_flops_ratio": peak_ratio,
+        "mfu_parity_v100_seq_s": round(baseline, 2) if baseline else None,
         **perf,
     }
 
@@ -720,6 +723,10 @@ def main():
     ap.add_argument("--resnet-sweep", action="store_true",
                     help="sweep ResNet input dtype (f32/bf16) x batch "
                          "(128/256), writing BENCH_RESNET_SWEEP.json")
+    ap.add_argument("--overlap", action="store_true",
+                    help="measure the overlap scheduler on vs off (img/s + "
+                         "profiler comm-hidden ratio), writing "
+                         "BENCH_OVERLAP.json")
     ap.add_argument("--only", default=None,
                     help="re-measure ONE record through the driver and "
                          "update it in BENCH_SUITE.json (a family name, or "
@@ -730,6 +737,12 @@ def main():
 
     if args.goldens:
         print(json.dumps(loss_goldens(), indent=1))
+        return
+
+    if args.overlap:
+        from benchmarks.overlap_bench import run_suite
+
+        run_suite("BENCH_OVERLAP.json")
         return
 
     from bagua_tpu.parallel.mesh import build_mesh
