@@ -1,0 +1,43 @@
+"""bagua-lint: static analysis for collective-consistency and hot-path hygiene.
+
+Bagua's premise (arXiv 2107.01499) is decoupling *what/when to communicate*
+from *how* — which in this JAX rebuild means several independently-evolving
+constructions of the same algorithm step (serialized, overlap-streamed,
+chunked-ring).  A silent divergence in collective order, mesh-axis usage, or
+``cond``-branch comm is an SPMD deadlock or a wrong-gradient bug that no
+single-process test can see.  Following the MPI-Checker line of work (static
+matching of collective call sites, Droste et al., LLVM-HPC 2015), this
+subsystem catches that hazard class statically:
+
+* :mod:`.jaxpr_check` — traces each algorithm family's step function through
+  the trainer's abstract-eval hook (``BaguaTrainer.trace_step``), extracts
+  the collective primitives, and verifies mesh-axis binding, ``cond``/
+  ``switch`` branch agreement, and overlap-vs-serialized collective-multiset
+  equality with per-bucket byte accounting.
+* :mod:`.ast_rules` — an AST rule engine over the package source: host-sync
+  calls in traced code, raw ``BAGUA_*`` env reads outside the registry,
+  tracer leakage onto ``self``, nondeterministic Python RNG in traced code,
+  copy-pasted helper lambdas, and torch imports.
+
+Run as a CLI (``python -m bagua_tpu.analysis bagua_tpu/`` — the CI gate,
+see ``scripts/ci.sh``) or through pytest (``tests/test_analysis.py``).
+Findings carry ``path:line`` + rule id + a fix hint; suppress with
+``# bagua: lint-ignore[rule-id] -- reason``; pre-existing violations live in
+the shrink-only baseline ``.bagua-lint-baseline.json``.
+
+This module stays import-light (no jax): the AST engine must run anywhere.
+The jaxpr checker imports jax lazily.
+"""
+
+from .findings import Finding, load_baseline, save_baseline  # noqa: F401
+from .ast_rules import RULES, run_ast_rules  # noqa: F401
+from .suppressions import parse_suppressions  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "run_ast_rules",
+    "parse_suppressions",
+    "load_baseline",
+    "save_baseline",
+]

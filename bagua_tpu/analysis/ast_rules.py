@@ -1,0 +1,461 @@
+"""AST hot-path rule engine.
+
+Rules walk each module's AST once, sharing a *traced-region* analysis: a
+function is considered traced when it is (a) passed to / decorated with a JAX
+tracing entry point (``jit``, ``shard_map``, ``lax.scan``/``cond``/
+``switch``/``while_loop``/``fori_loop``/``map``, ``vmap``, ``pmap``,
+``grad``, ``value_and_grad``, ``checkpoint``/``remat``, ``eval_shape``,
+``make_jaxpr``), or (b) defined inside a traced function.  The analysis is
+syntactic — a method called *from* a traced function is not marked (no
+interprocedural call graph) — so the rules catch the direct step-construction
+code, which is where this repo's hot paths live.
+
+Each rule carries an id (the suppression / baseline key), a rationale, and a
+fix hint; the catalog renders into ``docs/analysis.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, Iterator, List, Optional, Set
+
+from .findings import Finding
+from .suppressions import is_suppressed, parse_suppressions
+
+#: call suffixes that start a trace (matched against the dotted callee name)
+_TRACE_ENTRY_SUFFIXES = (
+    "jit",
+    "shard_map",
+    "lax.scan",
+    "lax.cond",
+    "lax.switch",
+    "lax.while_loop",
+    "lax.fori_loop",
+    "lax.map",
+    "lax.associative_scan",
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "value_and_grad_aux",
+    "checkpoint",
+    "remat",
+    "eval_shape",
+    "make_jaxpr",
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` -> "jax.lax.scan"; None for non-name chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_trace_entry(dotted: Optional[str]) -> bool:
+    if not dotted:
+        return False
+    return any(
+        dotted == s or dotted.endswith("." + s) for s in _TRACE_ENTRY_SUFFIXES
+    )
+
+
+@dataclass
+class ModuleInfo:
+    path: str          # repo-relative posix path
+    source: str
+    tree: ast.Module
+    lines: List[str]
+    #: every node lexically inside a traced function (identity set)
+    traced_nodes: Set[int] = field(default_factory=set)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule.id,
+            path=self.path,
+            line=getattr(node, "lineno", 0),
+            message=message,
+            hint=rule.hint,
+            text=self.line_text(getattr(node, "lineno", 0)),
+        )
+
+    def in_traced(self, node: ast.AST) -> bool:
+        return id(node) in self.traced_nodes
+
+
+def _mark_traced_regions(info: ModuleInfo) -> None:
+    """Populate ``info.traced_nodes`` (two passes + closure over nesting)."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(info.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    roots: List[ast.AST] = []
+
+    def _mark_callable_arg(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Lambda):
+            roots.append(arg)
+        elif isinstance(arg, ast.Name):
+            roots.extend(defs_by_name.get(arg.id, ()))
+
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if _is_trace_entry(dotted):
+                for arg in node.args:
+                    _mark_callable_arg(arg)
+                for kw in node.keywords:
+                    if kw.arg in (None, "mesh", "in_specs", "out_specs",
+                                  "static_argnums", "donate_argnums",
+                                  "axis_name", "length"):
+                        continue
+                    _mark_callable_arg(kw.value)
+            elif dotted in ("partial", "functools.partial") and node.args:
+                # partial(jax.jit, ...)(f) / @partial(jax.jit, ...)
+                if _is_trace_entry(_dotted(node.args[0])):
+                    for arg in node.args[1:]:
+                        _mark_callable_arg(arg)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                dotted = _dotted(target)
+                if _is_trace_entry(dotted):
+                    roots.append(node)
+                elif (
+                    isinstance(dec, ast.Call)
+                    and dotted in ("partial", "functools.partial")
+                    and dec.args
+                    and _is_trace_entry(_dotted(dec.args[0]))
+                ):
+                    roots.append(node)
+
+    for root in roots:
+        for sub in ast.walk(root):
+            info.traced_nodes.add(id(sub))
+
+
+# ---- rules ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    rationale: str
+    hint: str
+
+    def visit(self, info: ModuleInfo) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class HostSyncInTrace(Rule):
+    """Host synchronization inside traced step code."""
+
+    _NP_SYNC = ("np.asarray", "numpy.asarray", "onp.asarray",
+                "np.array", "numpy.array", "onp.array")
+
+    def visit(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call) and info.in_traced(node)):
+                continue
+            dotted = _dotted(node.func)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "block_until_ready":
+                yield info.finding(
+                    self, node,
+                    "`.block_until_ready()` inside traced code forces a "
+                    "host sync at trace time",
+                )
+            elif dotted and (
+                dotted == "jax.device_get"
+                or dotted.endswith(".device_get")
+            ):
+                yield info.finding(
+                    self, node,
+                    "`jax.device_get` inside traced code pulls the value "
+                    "to host, breaking the trace",
+                )
+            elif dotted in self._NP_SYNC:
+                yield info.finding(
+                    self, node,
+                    f"`{dotted}` materializes a traced value on host; use "
+                    "`jnp` inside traced code",
+                )
+            elif dotted == "float" and node.args:
+                yield info.finding(
+                    self, node,
+                    "`float()` on a traced value is a host readback "
+                    "(ConcretizationError at best, a sync at worst)",
+                )
+
+
+class RawEnvRead(Rule):
+    """Ad-hoc ``BAGUA_*`` environment reads outside the registry."""
+
+    def _bagua_const(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value.startswith("BAGUA_"):
+            return node.value
+        return None
+
+    def visit(self, info: ModuleInfo) -> Iterator[Finding]:
+        if info.path.replace(os.sep, "/").endswith("bagua_tpu/env.py"):
+            return
+        for node in ast.walk(info.tree):
+            var = None
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                if dotted and (
+                    dotted.endswith("environ.get") or dotted.endswith("getenv")
+                ) and node.args:
+                    var = self._bagua_const(node.args[0])
+            elif isinstance(node, ast.Subscript):
+                dotted = _dotted(node.value)
+                if dotted and dotted.endswith("environ"):
+                    var = self._bagua_const(node.slice)
+            if var:
+                yield info.finding(
+                    self, node,
+                    f"raw os.environ read of {var} outside the env registry",
+                )
+
+
+class TracerLeak(Rule):
+    """Storing values on ``self`` from inside a traced function."""
+
+    def visit(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not info.in_traced(node):
+                continue
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in ("self", "cls"):
+                    yield info.finding(
+                        self, node,
+                        f"assignment to `{t.value.id}.{t.attr}` inside "
+                        "traced code leaks a tracer into host state",
+                    )
+
+
+class PyRngInTrace(Rule):
+    """Nondeterministic Python/NumPy RNG inside traced code."""
+
+    _PREFIXES = ("random.", "np.random.", "numpy.random.", "onp.random.")
+
+    def visit(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call) and info.in_traced(node)):
+                continue
+            dotted = _dotted(node.func)
+            if dotted and dotted.startswith(self._PREFIXES):
+                yield info.finding(
+                    self, node,
+                    f"`{dotted}` in traced code bakes ONE sample into the "
+                    "compiled program (and differs across ranks)",
+                )
+
+
+class DupLambda(Rule):
+    """Copy-pasted helper lambdas within one module."""
+
+    #: minimum identical copies before the duplication is worth a finding
+    MIN_COPIES = 3
+
+    def _shape(self, node: ast.Lambda) -> Optional[str]:
+        # normalize argument names positionally so `lambda t: f(t)` and
+        # `lambda u: f(u)` dedupe; trivial lambdas (no call in the body)
+        # are idiom, not duplication
+        if not any(isinstance(n, ast.Call) for n in ast.walk(node.body)):
+            return None
+        clone = ast.parse(ast.unparse(node), mode="eval").body
+        rename = {
+            a.arg: f"_a{i}" for i, a in enumerate(clone.args.args)
+        }
+        for sub in ast.walk(clone):
+            if isinstance(sub, ast.Name) and sub.id in rename:
+                sub.id = rename[sub.id]
+            elif isinstance(sub, ast.arg) and sub.arg in rename:
+                sub.arg = rename[sub.arg]
+        return ast.dump(clone)
+
+    def visit(self, info: ModuleInfo) -> Iterator[Finding]:
+        # outermost lambdas only: a duplicated outer lambda would otherwise
+        # drag its inner lambdas into their own duplicate groups, double-
+        # reporting every site
+        nested: Set[int] = set()
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Lambda):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Lambda) and sub is not node:
+                        nested.add(id(sub))
+        groups: Dict[str, List[ast.Lambda]] = {}
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Lambda) and id(node) not in nested:
+                shape = self._shape(node)
+                if shape:
+                    groups.setdefault(shape, []).append(node)
+        for nodes in groups.values():
+            if len(nodes) < self.MIN_COPIES:
+                continue
+            first = min(n.lineno for n in nodes)
+            for node in nodes:
+                yield info.finding(
+                    self, node,
+                    f"lambda duplicated {len(nodes)}x in this module "
+                    f"(first at line {first})",
+                )
+
+
+class TorchImport(Rule):
+    """No torch imports in the TPU package (ci.sh's historical gate)."""
+
+    def visit(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module and node.module.split(".")[0] == "torch":
+                    yield info.finding(
+                        self, node, "torch import in the TPU package"
+                    )
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] == "torch":
+                        yield info.finding(
+                            self, node, "torch import in the TPU package"
+                        )
+
+
+RULES: List[Rule] = [
+    HostSyncInTrace(
+        id="host-sync-in-trace",
+        summary="host-sync call (`block_until_ready`, `np.asarray`, "
+                "`jax.device_get`, `float()`) inside jit/scan-traced code",
+        rationale="Host syncs inside a traced step either fail at trace time "
+                  "(ConcretizationError) or silently serialize dispatch, "
+                  "defeating the overlap scheduler the step exists to feed.",
+        hint="keep host readbacks outside the step; use `jnp` ops or "
+             "`jax.debug.*` inside traces",
+    ),
+    RawEnvRead(
+        id="raw-env-read",
+        summary="`os.environ` read of a `BAGUA_*` name outside `env.py`",
+        rationale="Scattered env reads drift from the documented defaults "
+                  "and types; the registry in `bagua_tpu.env` is the single "
+                  "source of truth (and generates docs/env_vars.md).",
+        hint="declare the variable in `env.ENV_REGISTRY` and read it "
+             "through an `env.*` accessor",
+    ),
+    TracerLeak(
+        id="tracer-leak",
+        summary="assignment to `self.*` from inside a traced function",
+        rationale="A tracer stored on a host object outlives its trace; "
+                  "the next use raises `UnexpectedTracerError` or — worse — "
+                  "silently freezes a stale constant into later compiles.",
+        hint="return the value through the traced function's outputs "
+             "instead of stashing it on the instance",
+    ),
+    PyRngInTrace(
+        id="py-rng-in-trace",
+        summary="Python/NumPy RNG call inside traced code",
+        rationale="`random.*`/`np.random.*` run at TRACE time: one sample is "
+                  "baked into the compiled program forever, and each rank "
+                  "bakes a different one — silent SPMD divergence.",
+        hint="thread a `jax.random` key through the step instead",
+    ),
+    DupLambda(
+        id="dup-lambda",
+        summary="identical helper lambda copy-pasted 3+ times in a module",
+        rationale="Copy-pasted traced helpers drift independently (one gets "
+                  "a fix, its clones keep the bug) — the exact failure mode "
+                  "behind the five `stack = lambda t: ...` copies this rule "
+                  "was built on.",
+        hint="hoist one module-level helper and call it everywhere",
+    ),
+    TorchImport(
+        id="torch-import",
+        summary="torch import inside bagua_tpu",
+        rationale="The package is a from-scratch JAX rebuild; a torch import "
+                  "is always an accident (and an instant ImportError on "
+                  "TPU images).",
+        hint="port the call to jax/jnp or move it to a contrib example",
+    ),
+]
+
+
+# ---- engine --------------------------------------------------------------
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".pytest_cache")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def analyze_source(
+    path: str, source: str, rules: Optional[List[Rule]] = None
+) -> List[Finding]:
+    """Run the rules over one module's source.  Returns ACTIVE findings
+    (suppressions already applied; malformed suppressions reported)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(
+            rule="syntax-error", path=path, line=e.lineno or 0,
+            message=f"cannot parse: {e.msg}", text="",
+        )]
+    info = ModuleInfo(
+        path=path, source=source, tree=tree, lines=source.splitlines()
+    )
+    _mark_traced_regions(info)
+    suppressions, problems = parse_suppressions(path, source)
+    findings: List[Finding] = list(problems)
+    for rule in (RULES if rules is None else rules):
+        for f in rule.visit(info):
+            if not is_suppressed(f, suppressions):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run_ast_rules(
+    paths: Iterable[str],
+    rules: Optional[List[Rule]] = None,
+    rel_to: Optional[str] = None,
+) -> List[Finding]:
+    """Run the engine over files/directories; paths in findings are made
+    relative to ``rel_to`` (default: cwd) and posix-normalized."""
+    base = os.path.abspath(rel_to or os.getcwd())
+    findings: List[Finding] = []
+    for fp in iter_py_files(paths):
+        rel = os.path.relpath(os.path.abspath(fp), base)
+        rel = rel.replace(os.sep, "/")
+        with open(fp, encoding="utf-8") as fh:
+            source = fh.read()
+        findings.extend(analyze_source(rel, source, rules))
+    return findings

@@ -1,0 +1,167 @@
+"""``bagua-lint`` CLI: ``python -m bagua_tpu.analysis [paths...]``.
+
+Runs the AST rule engine over the given paths (default: the installed
+``bagua_tpu`` package) and the jaxpr collective-consistency sweep over the
+algorithm families, compares against the shrink-only baseline, and exits
+non-zero on any unsuppressed, unbaselined finding — the CI gate wired into
+``scripts/ci.sh``.
+
+The jaxpr sweep needs a device mesh; the CLI forces the same 8-way virtual
+CPU mesh the test harness uses (``xla_force_host_platform_device_count``),
+so results are deterministic on any machine, TPU or not.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .ast_rules import RULES, run_ast_rules
+from .findings import (
+    BASELINE_DEFAULT,
+    Finding,
+    load_baseline,
+    save_baseline,
+    split_by_baseline,
+)
+
+
+def _ensure_cpu_sim() -> None:
+    """Pin the 8-device cpu-sim mesh BEFORE any jax backend initializes
+    (same mechanism as tests/conftest.py and the launcher's dryrun)."""
+    os.environ.setdefault("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+        os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from .. import env
+
+    env.sanitize_cpu_sim_env(os.environ)
+
+
+def _default_paths() -> List[str]:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [pkg]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        "python -m bagua_tpu.analysis",
+        description="bagua-lint: jaxpr collective-consistency checker + "
+                    "AST hot-path analyzer",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: bagua_tpu/)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: ./{BASELINE_DEFAULT} "
+                         "when present)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline with the current findings "
+                         "(shrink-only workflow: run after fixing entries)")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip the jaxpr consistency sweep (AST rules only)")
+    ap.add_argument("--jaxpr-only", action="store_true",
+                    help="run only the jaxpr consistency sweep")
+    ap.add_argument("--families", default=None,
+                    help="comma-separated algorithm families for the jaxpr "
+                         "sweep (default: gradient_allreduce,zero,bytegrad)")
+    ap.add_argument("--accum-steps", default=None,
+                    help="comma-separated accum_steps for the sweep "
+                         "(default: 1,4)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="findings only, no per-trace progress")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.id}: {r.summary}")
+            print(f"    why:  {r.rationale}")
+            print(f"    hint: {r.hint}")
+        print("cond-collective-divergence: cond/switch branches issue "
+              "different collective sequences (jaxpr checker)")
+        print("unbound-mesh-axis: collective axis not bound on the declared "
+              "mesh (jaxpr checker)")
+        print("overlap-serialized-divergence: overlap and serialized step "
+              "constructions emit different collective multisets "
+              "(jaxpr checker)")
+        return 0
+
+    findings: List[Finding] = []
+
+    if not args.jaxpr_only:
+        paths = args.paths or _default_paths()
+        findings.extend(run_ast_rules(paths))
+
+    if not args.no_jaxpr:
+        _ensure_cpu_sim()
+        from .jaxpr_check import (
+            DEFAULT_ACCUM_STEPS,
+            DEFAULT_FAMILIES,
+            run_jaxpr_checks,
+        )
+
+        families = (
+            tuple(f for f in args.families.split(",") if f)
+            if args.families else DEFAULT_FAMILIES
+        )
+        accum = (
+            tuple(int(a) for a in args.accum_steps.split(",") if a)
+            if args.accum_steps else DEFAULT_ACCUM_STEPS
+        )
+        jaxpr_findings, reports = run_jaxpr_checks(families, accum)
+        findings.extend(jaxpr_findings)
+        if not args.quiet:
+            for rep in reports:
+                status = "OK " if rep.get("equal") else "FAIL"
+                ser = rep["serialized"]["total_wire_bytes"]
+                ovl = rep["overlap"]["total_wire_bytes"]
+                n = len(rep["serialized"]["collectives"])
+                print(
+                    f"jaxpr[{status}] {rep['family']} "
+                    f"accum={rep['accum_steps']}: {n} collectives, "
+                    f"wire bytes serialized={ser} overlap={ovl}"
+                )
+                for row in rep["serialized"]["buckets"]:
+                    print(
+                        f"    bucket {row['bucket']}: flat "
+                        f"{row['flat_bytes']} B -> {row['wire_bytes']} B on "
+                        f"the wire across {len(row['collectives'])} "
+                        "collectives"
+                    )
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(BASELINE_DEFAULT):
+        baseline_path = BASELINE_DEFAULT
+
+    if args.write_baseline:
+        out = baseline_path or BASELINE_DEFAULT
+        save_baseline(out, findings)
+        print(f"wrote {len(findings)} baseline entries to {out}")
+        return 0
+
+    stale: List = []
+    baselined: List[Finding] = []
+    if baseline_path:
+        new, baselined, stale = split_by_baseline(
+            findings, load_baseline(baseline_path)
+        )
+    else:
+        new = findings
+
+    for f in new:
+        print(f.render())
+
+    print(
+        f"bagua-lint: {len(new)} finding(s)"
+        + (f", {len(baselined)} baselined" if baselined else "")
+        + (f", {len(stale)} STALE baseline entr(y/ies)" if stale else "")
+    )
+    if stale:
+        for k in stale:
+            print(f"  stale baseline entry (violation fixed — prune it): {k}")
+        print(f"  shrink the baseline: python -m bagua_tpu.analysis "
+              f"--write-baseline --baseline {baseline_path}")
+    return 1 if (new or stale) else 0

@@ -1,0 +1,403 @@
+"""jaxpr collective-consistency checker.
+
+Traces a step construction to its ``ClosedJaxpr`` (via
+``BaguaTrainer.trace_step`` — abstract eval only, nothing compiles or runs)
+and extracts every collective primitive, recursing through nested jaxprs
+(``pjit``/``shard_map``/``scan``/``while``/``cond``/``custom_*``).  Three
+checks, in the MPI-Checker tradition of static collective matching:
+
+1. **axis binding** — every collective's axis name must be an axis of the
+   declared mesh; an unbound name is a guaranteed trace/compile failure at
+   best and a wrong-communicator reduction at worst.
+2. **branch agreement** — each ``lax.cond``/``switch`` eqn's branches must
+   issue the *same sequence* of collective signatures (primitive, axes,
+   shape, dtype).  Under SPMD a per-rank predicate with divergent branch
+   collectives is a deadlock: rank A enters a psum that rank B never posts.
+   (Branch-varying non-collective compute — including ``ppermute``
+   permutation tables, which move data but always post — is fine.)
+3. **construction equivalence** — the overlap-streamed and serialized
+   constructions of the same algorithm must emit the same MULTISET of
+   collective signatures, with per-bucket byte accounting: PR 2's "one
+   implementation, the paths cannot drift" claim as a checked invariant.
+
+jax names the ``psum_scatter`` primitive ``reduce_scatter`` in jaxprs; the
+extractor canonicalizes to the user-facing name.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+#: jaxpr primitive name -> canonical collective name
+COLLECTIVE_PRIMS = {
+    "psum": "psum",
+    "psum_scatter": "psum_scatter",
+    "reduce_scatter": "psum_scatter",
+    "all_gather": "all_gather",
+    "ppermute": "ppermute",
+    "pshuffle": "ppermute",
+    "all_to_all": "all_to_all",
+    "pmax": "pmax",
+    "pmin": "pmin",
+    "pbroadcast": "pbroadcast",
+}
+
+#: families the CLI sweep proves overlap-vs-serialized equivalence for
+DEFAULT_FAMILIES = ("gradient_allreduce", "zero", "bytegrad")
+DEFAULT_ACCUM_STEPS = (1, 4)
+
+
+@dataclass(frozen=True)
+class Collective:
+    """One collective call site's signature, as SPMD matching sees it."""
+
+    prim: str
+    axes: Tuple[str, ...]
+    shape: Tuple[int, ...]
+    dtype: str
+
+    @property
+    def nbytes(self) -> int:
+        import numpy as np
+
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n * np.dtype(self.dtype).itemsize
+
+    def render(self) -> str:
+        shape = "x".join(map(str, self.shape)) or "scalar"
+        return (f"{self.prim}[{','.join(self.axes)}] "
+                f"{shape}:{self.dtype} ({self.nbytes} B)")
+
+
+def _collective_axes(params: Dict[str, Any]) -> Tuple[str, ...]:
+    axes = params.get("axes", params.get("axis_name", ()))
+    if isinstance(axes, str):
+        axes = (axes,)
+    return tuple(str(a) for a in axes if isinstance(a, (str,)))
+
+
+def _sub_jaxprs(eqn) -> Iterator[Tuple[str, Any]]:
+    """(param_name, Jaxpr) for every nested jaxpr in an eqn's params."""
+    for k, v in eqn.params.items():
+        items = v if isinstance(v, (tuple, list)) else (v,)
+        for item in items:
+            inner = getattr(item, "jaxpr", item)  # ClosedJaxpr -> Jaxpr
+            if hasattr(inner, "eqns"):
+                yield k, inner
+
+
+def _eqn_collective(eqn) -> Optional[Collective]:
+    name = COLLECTIVE_PRIMS.get(eqn.primitive.name)
+    if name is None:
+        return None
+    # signature on the PRIMARY operand: what must agree across ranks for
+    # the collective to match (multi-operand psums yield one per operand)
+    aval = eqn.invars[0].aval
+    return Collective(
+        prim=name,
+        axes=_collective_axes(eqn.params),
+        shape=tuple(int(d) for d in aval.shape),
+        dtype=str(aval.dtype),
+    )
+
+
+def iter_collectives(
+    jaxpr,
+    on_branching: Optional[Callable] = None,
+) -> Iterator[Collective]:
+    """DFS over ``jaxpr`` (a ``Jaxpr`` or ``ClosedJaxpr``) yielding
+    collectives in program order.  ``on_branching(eqn, branch_seqs)`` is
+    invoked for every ``cond``/``switch`` eqn with the per-branch collective
+    sequences (branch collectives are ALSO yielded, first branch only, so a
+    multiset over a consistent program counts each site once)."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jaxpr.eqns:
+        c = _eqn_collective(eqn)
+        if c is not None:
+            for v in eqn.invars:
+                aval = getattr(v, "aval", None)
+                if aval is None or not hasattr(aval, "shape"):
+                    continue
+                yield Collective(
+                    prim=c.prim,
+                    axes=c.axes,
+                    shape=tuple(int(d) for d in aval.shape),
+                    dtype=str(aval.dtype),
+                )
+            continue
+        if eqn.primitive.name == "cond":  # lax.cond AND lax.switch
+            branches = [
+                list(iter_collectives(b, on_branching))
+                for b in eqn.params["branches"]
+            ]
+            if on_branching is not None:
+                on_branching(eqn, branches)
+            if branches:
+                for c in branches[0]:
+                    yield c
+            continue
+        for _, sub in _sub_jaxprs(eqn):
+            yield from iter_collectives(sub, on_branching)
+
+
+def collect(jaxpr) -> Tuple[List[Collective], List[Finding]]:
+    """All collectives in program order + branch-divergence findings."""
+    findings: List[Finding] = []
+
+    def on_branching(eqn, branch_seqs):
+        sigs = [tuple(seq) for seq in branch_seqs]
+        if len(set(sigs)) > 1:
+            desc = " | ".join(
+                f"branch {i}: "
+                + (", ".join(c.render() for c in seq) or "(no collectives)")
+                for i, seq in enumerate(sigs)
+            )
+            findings.append(Finding(
+                rule="cond-collective-divergence",
+                path="<jaxpr>",
+                line=0,
+                message=(
+                    "cond/switch branches issue different collective "
+                    f"sequences — SPMD divergence deadlocks: {desc}"
+                ),
+                hint="hoist the collective out of the cond, or make every "
+                     "branch post the identical collective sequence",
+                text=desc,
+            ))
+
+    seq = list(iter_collectives(jaxpr, on_branching))
+    return seq, findings
+
+
+def check_axis_binding(
+    collectives: Sequence[Collective], mesh_axes: Sequence[str],
+    context: str = "",
+) -> List[Finding]:
+    known = set(mesh_axes)
+    findings = []
+    for c in collectives:
+        missing = [a for a in c.axes if a not in known]
+        if missing:
+            findings.append(Finding(
+                rule="unbound-mesh-axis",
+                path="<jaxpr>",
+                line=0,
+                message=(
+                    f"{context + ': ' if context else ''}{c.render()} uses "
+                    f"axis {missing} not bound on the mesh "
+                    f"(axes: {sorted(known)})"
+                ),
+                hint="declare the axis on the trainer mesh or fix the "
+                     "collective's axis_name",
+                text=f"{context}:{c.prim}:{','.join(missing)}",
+            ))
+    return findings
+
+
+# ---- construction equivalence (overlap vs serialized) --------------------
+
+
+def multiset(collectives: Sequence[Collective]) -> Counter:
+    return Counter(collectives)
+
+
+def diff_multisets(a: Counter, b: Counter) -> str:
+    lines = []
+    for c in sorted(set(a) | set(b), key=lambda c: (c.prim, c.shape)):
+        na, nb = a.get(c, 0), b.get(c, 0)
+        if na != nb:
+            lines.append(f"  {c.render()}: serialized x{na}, overlap x{nb}")
+    return "\n".join(lines)
+
+
+def _bucket_accounting(trainer, collectives: Sequence[Collective]) -> List[dict]:
+    """Per-bucket byte accounting: which collectives carried each bucket's
+    flat buffer (full-flat or 1/world chunk payloads, by numel match).
+    Each collective is attributed to exactly ONE bucket — same-sized buckets
+    split their group's matches evenly — so summing the rows never exceeds
+    the trace's total wire bytes."""
+    import numpy as np
+
+    world = trainer.world_size
+
+    def numels_of(bucket) -> Tuple[int, ...]:
+        padded = bucket.padded_numel
+        chunk = padded // world if padded % world == 0 else -1
+        return (padded, chunk)
+
+    buckets = list(trainer._plan.buckets)
+    # matches per size-group, then an even share per member bucket
+    group_sizes = Counter(numels_of(b) for b in buckets)
+    group_matches: Dict[Tuple[int, ...], List[Collective]] = {
+        key: [
+            c for c in collectives
+            if int(np.prod(c.shape or (1,))) in key
+        ]
+        for key in group_sizes
+    }
+    taken = Counter()
+    rows = []
+    for i, bucket in enumerate(buckets):
+        key = numels_of(bucket)
+        pool, n = group_matches[key], group_sizes[key]
+        share = len(pool) // n + (1 if taken[key] < len(pool) % n else 0)
+        start = sum(
+            len(pool) // n + (1 if j < len(pool) % n else 0)
+            for j in range(taken[key])
+        )
+        matched = pool[start:start + share]
+        taken[key] += 1
+        rows.append({
+            "bucket": i,
+            "padded_numel": int(bucket.padded_numel),
+            "flat_bytes": int(
+                bucket.padded_numel * np.dtype(bucket.dtype).itemsize
+            ),
+            "collectives": [c.render() for c in matched],
+            "wire_bytes": int(sum(c.nbytes for c in matched)),
+        })
+    return rows
+
+
+def check_equivalence(
+    family: str,
+    accum_steps: int,
+    trace_fn: Callable[[str], Tuple[Any, Any]],
+) -> Tuple[List[Finding], dict]:
+    """Trace both constructions of one family (``trace_fn(overlap_mode) ->
+    (trainer, jaxpr)``) and require collective-multiset equality."""
+    findings: List[Finding] = []
+    report: dict = {"family": family, "accum_steps": accum_steps}
+    seqs: Dict[str, List[Collective]] = {}
+    for mode in ("off", "on"):
+        trainer, jaxpr = trace_fn(mode)
+        seq, branch_findings = collect(jaxpr)
+        findings.extend(branch_findings)
+        findings.extend(check_axis_binding(
+            seq, trainer.mesh.axis_names,
+            context=f"{family}/accum{accum_steps}/overlap={mode}",
+        ))
+        seqs[mode] = seq
+        key = "serialized" if mode == "off" else "overlap"
+        report[key] = {
+            "collectives": [c.render() for c in seq],
+            "total_wire_bytes": int(sum(c.nbytes for c in seq)),
+            "buckets": _bucket_accounting(trainer, seq),
+        }
+    ser, ovl = multiset(seqs["off"]), multiset(seqs["on"])
+    report["equal"] = ser == ovl
+    if ser != ovl:
+        findings.append(Finding(
+            rule="overlap-serialized-divergence",
+            path="<jaxpr>",
+            line=0,
+            message=(
+                f"{family} (accum_steps={accum_steps}): overlap and "
+                "serialized constructions emit different collective "
+                f"multisets:\n{diff_multisets(ser, ovl)}"
+            ),
+            hint="both paths must ride Algorithm.reduce_bucket_grad — one "
+                 "implementation, so they cannot drift",
+            text=f"{family}:accum{accum_steps}",
+        ))
+    return findings, report
+
+
+# ---- family harness ------------------------------------------------------
+
+
+def _mlp_fixture(key_scale: float = 0.02):
+    """Tiny deterministic MLP: enough params for several buckets at a small
+    bucket size, divisible shapes for the 8-way cpu-sim mesh."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    dims = [8, 32, 32, 4]
+    params = {}
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f"w{i}"] = jnp.asarray(
+            rng.randn(a, b).astype(np.float32) * key_scale)
+        params[f"b{i}"] = jnp.zeros((b,), jnp.float32)
+
+    def loss_fn(p, batch):
+        x, y = batch["x"], batch["y"]
+        h = x
+        for i in range(len(dims) - 1):
+            h = h @ p[f"w{i}"] + p[f"b{i}"]
+            if i < len(dims) - 2:
+                h = jnp.tanh(h)
+        return jnp.mean((h - y) ** 2)
+
+    batch = {
+        "x": jnp.asarray(rng.randn(32, dims[0]).astype(np.float32)),
+        "y": jnp.asarray(rng.randn(32, dims[-1]).astype(np.float32)),
+    }
+    return params, batch, loss_fn
+
+
+def make_family_tracer(
+    family: str, accum_steps: int, bucket_bytes: int = 2048
+) -> Callable[[str], Tuple[Any, Any]]:
+    """``trace_fn(overlap_mode) -> (trainer, ClosedJaxpr)`` for one
+    algorithm family's real step builder on the ambient (cpu-sim) mesh."""
+    import optax
+
+    from ..core.backend import BaguaTrainer
+
+    def build(overlap: str):
+        from .. import algorithms
+
+        params, batch, loss_fn = _mlp_fixture()
+        if family == "gradient_allreduce":
+            algo = algorithms.GradientAllReduceAlgorithm()
+            optimizer = optax.sgd(1e-2)
+        elif family == "bytegrad":
+            algo = algorithms.ByteGradAlgorithm(hierarchical=False)
+            optimizer = optax.sgd(1e-2)
+        elif family == "zero":
+            algo = algorithms.ZeroOptimizerAlgorithm(optax.adam(1e-3))
+            optimizer = None
+        else:
+            raise ValueError(f"unknown family {family!r}")
+        trainer = BaguaTrainer(
+            loss_fn,
+            optimizer,
+            algo,
+            bucket_bytes=bucket_bytes,
+            accum_steps=accum_steps,
+            overlap=overlap,
+            autotune=False,
+        )
+        state = trainer.init(params)
+        return trainer, state, batch
+
+    def trace_fn(overlap: str):
+        trainer, state, batch = build(overlap)
+        return trainer, trainer.trace_step(state, batch)
+
+    return trace_fn
+
+
+def run_jaxpr_checks(
+    families: Sequence[str] = DEFAULT_FAMILIES,
+    accum_steps: Sequence[int] = DEFAULT_ACCUM_STEPS,
+) -> Tuple[List[Finding], List[dict]]:
+    """The CLI/CI sweep: overlap-vs-serialized equivalence (plus axis and
+    cond-branch consistency on every trace) for each family x accum."""
+    findings: List[Finding] = []
+    reports: List[dict] = []
+    for family in families:
+        for accum in accum_steps:
+            f, report = check_equivalence(
+                family, accum, make_family_tracer(family, accum)
+            )
+            findings.extend(f)
+            reports.append(report)
+    return findings, reports

@@ -1,0 +1,82 @@
+"""``# bagua: lint-ignore[rule-id] -- reason`` suppression comments.
+
+A trailing suppression covers its own line; a standalone suppression comment
+covers the next non-blank, non-comment source line (so long flagged lines can
+keep the suppression above them).  Multiple rule ids are comma-separated;
+``*`` suppresses every rule.  The ``-- reason`` is required: an unexplained
+suppression is itself reported (rule ``bad-suppression``) so "shut it up"
+can't happen silently.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, FrozenSet, List, Tuple
+
+from .findings import Finding
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*bagua:\s*lint-ignore\[([^\]]*)\]\s*(?:--\s*(\S.*))?"
+)
+
+
+def parse_suppressions(
+    path: str, source: str
+) -> Tuple[Dict[int, FrozenSet[str]], List[Finding]]:
+    """-> ({line: suppressed rule ids}, malformed-suppression findings)."""
+    by_line: Dict[int, set] = {}
+    problems: List[Finding] = []
+    pending: List[Tuple[int, set]] = []  # standalone comments awaiting code
+
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return {}, []
+
+    for tok in tokens:
+        if tok.type in (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                        tokenize.DEDENT, tokenize.ENCODING,
+                        tokenize.ENDMARKER):
+            continue
+        row = tok.start[0]
+        if tok.type == tokenize.COMMENT:
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = (m.group(2) or "").strip()
+            if not rules or not reason:
+                problems.append(Finding(
+                    rule="bad-suppression",
+                    path=path,
+                    line=row,
+                    message=(
+                        "malformed lint-ignore: need at least one rule id "
+                        "and a `-- reason`"
+                    ),
+                    hint="write `# bagua: lint-ignore[rule-id] -- why`",
+                    text=tok.line.strip(),
+                ))
+                continue
+            if tok.line[: tok.start[1]].strip():
+                # trailing comment: covers its own line
+                by_line.setdefault(row, set()).update(rules)
+            else:
+                # standalone: covers the next source line
+                pending.append((row, rules))
+        else:
+            # first real token on a line consumes pending suppressions
+            for _, rules in pending:
+                by_line.setdefault(row, set()).update(rules)
+            pending = []
+
+    return {k: frozenset(v) for k, v in by_line.items()}, problems
+
+
+def is_suppressed(
+    finding: Finding, suppressions: Dict[int, FrozenSet[str]]
+) -> bool:
+    rules = suppressions.get(finding.line)
+    return bool(rules) and (finding.rule in rules or "*" in rules)

@@ -373,7 +373,7 @@ class BaguaCommunicator:
     #: time 0.06/0.08/0.31 s at 32/64/256 devices (flat in practice), program
     #: text O(period × nranks).  The cap turns the far-out hazard (a pod-scale
     #: gossip axis compiling thousands of branches) into an explicit error.
-    MAX_EXCHANGE_PERIOD = int(os.environ.get("BAGUA_MAX_EXCHANGE_PERIOD", "128"))
+    MAX_EXCHANGE_PERIOD = env.get_max_exchange_period()
 
     def exchange_with_peer(self, x, peer_fn: Callable[[int, int, int], int], step):
         """Pairwise send/recv with a step-dependent symmetric pairing.
@@ -418,7 +418,7 @@ class BaguaCommunicator:
 
 
 #: compile-size guard for the chunked rings (see :func:`ring_chunks_for`)
-MAX_RING_CHUNKS = int(os.environ.get("BAGUA_MAX_RING_CHUNKS", "32"))
+MAX_RING_CHUNKS = env.get_max_ring_chunks()
 
 
 def ring_chunks_for(numel: int, itemsize: int, nranks: int,
@@ -531,8 +531,9 @@ def init_process_group(
     JAX coordination service), after which every host sees the full device
     set and the global mesh spans all chips.
     """
-    if coordinator_address is not None or os.environ.get("BAGUA_COORDINATOR_ADDR"):
-        addr = coordinator_address or os.environ["BAGUA_COORDINATOR_ADDR"]
+    env_addr = env.get_coordinator_addr()
+    if coordinator_address is not None or env_addr:
+        addr = coordinator_address or env_addr
         # CPU-simulation multiprocess runs need an explicit cross-process
         # collectives backend on jax versions where the CPU default is
         # "none" ("Multiprocess computations aren't implemented on the CPU

@@ -83,10 +83,10 @@ def _codec(comm: BaguaCommunicator):
     Pallas compress on TPU for chunks ≥1 MiB, the XLA lowering otherwise
     and for every decompress.  ``BAGUA_DISABLE_PALLAS_CODEC=1`` forces the
     jnp path for A/B checks."""
-    import os
+    from .. import env
 
     on_tpu = comm.mesh.devices.flat[0].platform == "tpu"
-    if on_tpu and os.environ.get("BAGUA_DISABLE_PALLAS_CODEC") != "1":
+    if on_tpu and not env.is_pallas_codec_disabled():
         from .pallas_codec import compress_chunked_pallas
 
         def compress(v, n):

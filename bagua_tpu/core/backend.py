@@ -39,6 +39,13 @@ from ..utils import StatisticalAverage
 logger = logging.getLogger(__name__)
 
 
+def _stack_tree(t):
+    """Add a leading length-1 per-rank axis to every leaf — the stacked
+    state layout the gossip/expert families shard over their rank axis
+    (``shard_map`` out_specs put the mesh axis on this new dimension)."""
+    return jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+
+
 def _find_adam_moments(opt_state):
     """Locate adam-family first/second moments inside a nested optax state
     (``ScaleByAdamState``-like: has param-shaped ``mu`` and ``nu``).  Returns
@@ -558,8 +565,7 @@ class BaguaTrainer:
             def init_fn(p):
                 a = algo.init_state(ctx, p)
                 o = opt_init(p)
-                stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
-                return stack(p), stack(o), stack(a)
+                return _stack_tree(p), _stack_tree(o), _stack_tree(a)
 
             out_spec = P((ep,))
             p_stacked, opt_state, algo_state = jax.jit(
@@ -634,11 +640,9 @@ class BaguaTrainer:
                 def init_fn_flat(p):
                     a = algo.init_state(ctx, p)
                     o = algo.init_optimizer_state_sharded(ctx, p)
-                    stack = lambda t: jax.tree.map(
-                        lambda x: jnp.asarray(x)[None], t)
                     zp = {"flats": tuple(plan.flatten_tree(p)), "local": {}}
-                    return zp, {"buckets": stack(o["buckets"]),
-                                "local": o["local"]}, stack(a)
+                    return zp, {"buckets": _stack_tree(o["buckets"]),
+                                "local": o["local"]}, _stack_tree(a)
 
                 zparams, opt_state, algo_state = jax.jit(
                     shard_map(init_fn_flat, mesh=mesh, in_specs=(in_spec,),
@@ -652,9 +656,8 @@ class BaguaTrainer:
             def init_fn(p):
                 a = algo.init_state(ctx, p)
                 o = algo.init_optimizer_state_sharded(ctx, p)
-                stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
-                return {"buckets": stack(o["buckets"]),
-                        "local": o["local"]}, stack(a)
+                return {"buckets": _stack_tree(o["buckets"]),
+                        "local": o["local"]}, _stack_tree(a)
 
             opt_state, algo_state = jax.jit(
                 shard_map(init_fn, mesh=mesh, in_specs=(in_spec,),
@@ -692,8 +695,7 @@ class BaguaTrainer:
         def init_fn(p):
             a = algo.init_state(ctx, p)
             o = opt_init(p)
-            stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
-            return stack(p), stack(o), stack(a)
+            return _stack_tree(p), _stack_tree(o), _stack_tree(a)
 
         specs = P(self.dp_axes)
         p_stacked, opt_state, algo_state = jax.jit(
@@ -722,7 +724,7 @@ class BaguaTrainer:
         # params stay replicated (model-parallel leaves: sharded in place)
         opt_stacked = replicated and algo.sharded_opt_state
         _unstack = lambda t: jax.tree.map(lambda x: x[0], t)
-        _stack = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+        _stack = _stack_tree
         # expert grads average over dp (+sp: partial-sequence contributions)
         # but never over ep, where experts differ
         expert_dp = tuple(
@@ -1065,6 +1067,20 @@ class BaguaTrainer:
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0] if analysis else {}
         return dict(analysis) if analysis else {}
+
+    def trace_step(self, state: TrainState, batch):
+        """Abstract-eval of the current train-step construction: the jitted
+        step's ``ClosedJaxpr``, obtained by tracing only — no compile, no
+        execution, ``state``/``batch`` untouched (donation binds at run
+        time, not trace time).  This is the entry point the
+        :mod:`bagua_tpu.analysis` jaxpr collective-consistency checker uses
+        to extract a construction's collective sequence (mesh-axis binding,
+        ``cond``-branch divergence, overlap-vs-serialized multiset
+        equality)."""
+        fn = self._get_step_fn()
+        if hasattr(fn, "trace"):  # jax >= 0.4.34 jit-stages API
+            return fn.trace(state, batch).jaxpr
+        return jax.make_jaxpr(lambda s, b: fn(s, b))(state, batch)
 
     def _make_eval_fn(self, state_specs, batch_spec):
         algo = self.algorithm

@@ -43,6 +43,8 @@ import sys
 import time
 from typing import List
 
+from .. import env as _env
+
 logger = logging.getLogger("bagua_tpu.launcher")
 
 # Errors that mean "this store connection is dead, get a new one".
@@ -129,11 +131,9 @@ def parse_args(argv=None):
         args.nnodes_int = int(args.nnodes)
         args.min_nnodes = args.max_nnodes = args.nnodes_int
     if args.join_window is None:
-        args.join_window = float(
-            os.environ.get("BAGUA_ELASTIC_JOIN_WINDOW_S", "30"))
+        args.join_window = _env.get_elastic_join_window_s()
     if args.lease_ttl is None:
-        args.lease_ttl = float(
-            os.environ.get("BAGUA_ELASTIC_LEASE_TTL_S", "15"))
+        args.lease_ttl = _env.get_elastic_lease_ttl_s()
     if args.max_restarts is None:
         # multi-node fixed-size default stays 0: coordinated restart
         # requires every node's launcher to use the same max_restarts > 0.
@@ -549,7 +549,7 @@ def _dump_elastic_telemetry(transitions) -> None:
     from ..telemetry import counters
 
     logger.info("elastic membership counters: %s", counters.snapshot())
-    out = os.environ.get("BAGUA_ELASTIC_TELEMETRY_OUT")
+    out = _env.get_elastic_telemetry_out()
     if not out:
         return
     try:

@@ -45,6 +45,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from .. import env as _env
+
 logger = logging.getLogger("bagua_tpu.elastic")
 
 # stop-event kinds (the first event of an attempt wins; every launcher
@@ -321,15 +323,15 @@ def publish_leave_intent(reason: str, timeout_s: float = 2.0) -> bool:
     the coordinator can tell a purposeful departure from a silent hang.
     Bounded and exception-free: the caller is about to die and must not be
     delayed by a gone store."""
-    addr = os.environ.get("BAGUA_ELASTIC_STORE_ADDR")
+    addr = _env.get_elastic_store_addr()
     if not addr:
         return False
     try:
         from ..contrib.utils.tcp_store import TCPStore
 
         host, port = addr.rsplit(":", 1)
-        epoch = int(os.environ.get("BAGUA_ELASTIC_EPOCH", "0"))
-        node_id = int(os.environ.get("BAGUA_ELASTIC_NODE_ID", "0"))
+        epoch = _env.get_elastic_epoch()
+        node_id = _env.get_elastic_node_id()
         store = TCPStore(host, int(port), timeout_s=timeout_s)
         try:
             store.set(_k_leave(epoch, node_id), reason)

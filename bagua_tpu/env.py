@@ -1,4 +1,4 @@
-"""Environment / flag accessors.
+"""Environment / flag accessors, backed by a declarative env-var registry.
 
 TPU-native counterpart of the reference's ``bagua/torch_api/env.py`` (see
 /root/reference/bagua/torch_api/env.py:1-101).  The reference reads
@@ -7,16 +7,201 @@ process-level topology comes from :mod:`jax` itself (``jax.process_index`` /
 ``jax.device_count``), while in-program data-parallel "ranks" are positions on a
 :class:`jax.sharding.Mesh` axis.  The ``BAGUA_*`` tunables keep their reference
 names so launcher scripts port over unchanged.
+
+Every ``BAGUA_*`` variable the package consumes is DECLARED here in
+:data:`ENV_REGISTRY` (name, type, default, doc) and read through the typed
+accessors below.  ``bagua-lint``'s ``raw-env-read`` rule enforces the
+discipline: any ``os.environ`` read of a ``BAGUA_*`` name outside this module
+is a finding, so a tunable cannot exist without a registry row — and
+``docs/env_vars.md`` (generated from the registry by
+``scripts/gen_env_docs.py``) cannot go stale.
 """
 
 from __future__ import annotations
 
 import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+# ---- registry ------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared environment variable: the single source of truth for its
+    type, default, and operator-facing documentation."""
+
+    name: str
+    type: str  # "int" | "float" | "bool" | "str" | "enum"
+    default: str  # raw (string) default, as the operator would spell it
+    doc: str
+    choices: Tuple[str, ...] = ()
+
+
+ENV_REGISTRY = {}
+
+
+def _declare(name: str, type: str, default: str, doc: str,
+             choices: Tuple[str, ...] = ()) -> None:
+    ENV_REGISTRY[name] = EnvVar(name, type, default, doc, choices)
+
+
+# -- core comm / bucketing --
+_declare("BAGUA_DEFAULT_BUCKET_SIZE", "int", str(10 * 1024 ** 2),
+         "Default communication bucket size in bytes (reference env.py:50-57).")
+_declare("BAGUA_OVERLAP", "enum", "auto",
+         "Overlap-scheduler dispatch gate: stream per-bucket gradient "
+         "collectives into backward/accumulation compute (`on`), keep the "
+         "exact serialized step construction (`off`), or take whichever "
+         "path measured faster (`auto`, see BENCH_OVERLAP.json).",
+         choices=("auto", "on", "off"))
+_declare("BAGUA_OVERLAP_CHUNK_BYTES", "int", "0",
+         "Target per-rank bytes of one independent ring sub-collective under "
+         "the overlap scheduler; 0 keeps the fused XLA collectives.")
+_declare("BAGUA_MAX_EXCHANGE_PERIOD", "int", "128",
+         "Largest step-pairing period precompiled into one program by "
+         "`exchange_with_peer` (compile-size guard for pod-scale gossip).")
+_declare("BAGUA_MAX_RING_CHUNKS", "int", "32",
+         "Compile-size guard for the chunked ring collectives: max "
+         "independent sub-collectives per bucket.")
+_declare("BAGUA_COORDINATOR_ADDR", "str", "",
+         "host:port of the JAX coordination service for multi-process "
+         "bring-up (consumed by `init_process_group`).")
+_declare("BAGUA_COMM_TIMEOUT_S", "str", "300",
+         "Hang-watchdog timeout for watched collectives, in seconds; "
+         "``0``/``off``/``false``/``none`` disables the watchdog.")
+# -- autotune sidecar --
+_declare("BAGUA_SERVICE_PORT", "int", "-1",
+         "Port of the autotune hyperparameter service; -1 disables.")
+_declare("BAGUA_AUTOTUNE", "int", "0",
+         "Autotune level: 0 off, 1 bucket-size search, 2 adds the "
+         "tensor-readiness telemetry pipeline.")
+_declare("BAGUA_AUTOTUNE_MAX_SAMPLES", "int", "60",
+         "Max hyperparameter samples the Bayesian optimizer may score.")
+_declare("BAGUA_AUTOTUNE_SAMPLING_CONFIDENCE_TIME_S", "float", "5.0",
+         "Seconds of speed samples per hyperparameter config before scoring.")
+_declare("BAGUA_AUTOTUNE_WARMUP_TIME_S", "float", "30.0",
+         "Warmup seconds before the autotuner starts scoring configs.")
+_declare("BAGUA_AUTOTUNE_ALGORITHM", "bool", "0",
+         "Let the autotuner search over algorithm families too "
+         "(centralized / low-precision selectable; TPU extension).")
+_declare("BAGUA_REPORT_METRICS", "bool", "0",
+         "Report training metrics to the autotune service.")
+_declare("BAGUA_IS_OUTPUT_AUTOTUNE_LOG", "bool", "0",
+         "Write the autotune search log to disk.")
+# -- profiling --
+_declare("BAGUA_PROFILE_DIR", "str", "",
+         "Directory for jax profiler traces; empty disables auto-capture.")
+_declare("BAGUA_PROFILE_STEPS", "str", "2:5",
+         "``start:stop`` step window (half-open) for trainer auto-capture.")
+# -- kernels / codecs --
+_declare("BAGUA_FLASH_ATTENTION", "bool", "1",
+         "Enable the Pallas flash-attention kernel above the measured "
+         "sequence-length crossover; 0 forces XLA's fused attention.")
+_declare("BAGUA_DISABLE_PALLAS_CODEC", "bool", "0",
+         "Force the jnp (XLA) MinMaxUInt8 codec lowering even on TPU "
+         "(A/B checks against the Pallas kernel).")
+# -- elastic membership (injected by the launcher, see distributed/run.py) --
+_declare("BAGUA_ELASTIC", "bool", "0",
+         "Set by the launcher when lease-based elastic membership is on.")
+_declare("BAGUA_ELASTIC_EPOCH", "int", "0",
+         "Rendezvous epoch fencing counter (launcher-injected).")
+_declare("BAGUA_ELASTIC_NODE_ID", "int", "0",
+         "This node's stable identity slot (launcher-injected).")
+_declare("BAGUA_ELASTIC_STORE_ADDR", "str", "",
+         "host:port of the restart TCPStore carrying membership leases.")
+_declare("BAGUA_ELASTIC_MIN_NNODES", "int", "1",
+         "Lower bound of the elastic world size (launcher-injected).")
+_declare("BAGUA_ELASTIC_MAX_NNODES", "int", "",
+         "Upper bound of the elastic world size (launcher-injected); "
+         "defaults to the launched node count when unset.")
+_declare("BAGUA_ELASTIC_JOIN_WINDOW_S", "float", "30",
+         "Seconds a rendezvous round stays open for late joiners.")
+_declare("BAGUA_ELASTIC_LEASE_TTL_S", "float", "15",
+         "Membership lease TTL; an expired lease shrinks the world.")
+_declare("BAGUA_ELASTIC_TELEMETRY_OUT", "str", "",
+         "Path where membership counters + transitions are dumped on exit.")
+
+
+# ---- typed accessors -----------------------------------------------------
+
+
+def _raw(name: str) -> Optional[str]:
+    """The ambient value of a REGISTERED variable (None/'' -> None).  The one
+    sanctioned ``os.environ`` read for ``BAGUA_*`` names — call sites outside
+    this module go through here (or the typed wrappers below) so bagua-lint's
+    ``raw-env-read`` rule can hold the line."""
+    if name not in ENV_REGISTRY:
+        raise KeyError(f"{name} is not declared in env.ENV_REGISTRY")
+    v = os.environ.get(name)
+    return None if v in (None, "") else v
+
+
+def env_str(name: str) -> str:
+    v = _raw(name)
+    return ENV_REGISTRY[name].default if v is None else v
+
+
+def env_int(name: str) -> int:
+    v = _raw(name)
+    if v is None:
+        return int(ENV_REGISTRY[name].default)
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be an integer, got {v!r}"
+        ) from None
+
+
+def env_float(name: str) -> float:
+    v = _raw(name)
+    if v is None:
+        return float(ENV_REGISTRY[name].default)
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a number, got {v!r}"
+        ) from None
+
+
+def env_bool(name: str) -> bool:
+    """Reference-compatible boolean: ``"1"`` is on, anything else off —
+    except vars whose DEFAULT is on, where only ``"0"`` turns them off
+    (matches the historical ``!= "0"`` gates)."""
+    v = _raw(name)
+    spec = ENV_REGISTRY[name]
+    if v is None:
+        return spec.default == "1"
+    return v != "0" if spec.default == "1" else v == "1"
+
+
+def env_enum(name: str) -> str:
+    v = env_str(name).strip().lower() or ENV_REGISTRY[name].default
+    choices = ENV_REGISTRY[name].choices
+    if choices and v not in choices:
+        raise ValueError(
+            f"{name} must be {'|'.join(choices)}, got {v!r}"
+        )
+    return v
 
 
 def _int_env(name: str, default: int) -> int:
+    """Unregistered int read (RANK/WORLD_SIZE-family launcher vars)."""
     v = os.environ.get(name)
-    return int(v) if v not in (None, "") else default
+    if v in (None, ""):
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(
+            f"environment variable {name} must be an integer, got {v!r}"
+        ) from None
+
+
+# ---- process topology (launcher-injected, reference names) ---------------
 
 
 def get_rank() -> int:
@@ -61,63 +246,126 @@ def get_master_addr() -> str:
     return os.environ.get("MASTER_ADDR", "127.0.0.1")
 
 
+# ---- named accessors (one per consumer call site family) -----------------
+
+
 def get_default_bucket_size() -> int:
     """Default bucket size in bytes; 10MB like the reference (env.py:50-57)."""
-    return _int_env("BAGUA_DEFAULT_BUCKET_SIZE", 10 * 1024 ** 2)
+    return env_int("BAGUA_DEFAULT_BUCKET_SIZE")
 
 
 def get_overlap_mode() -> str:
     """Overlap-scheduler dispatch gate: ``auto`` (default — the path that
     measured faster, see BENCH_OVERLAP.json), ``on``, or ``off`` (the exact
     serialized step construction)."""
-    v = os.environ.get("BAGUA_OVERLAP", "auto").strip().lower() or "auto"
-    if v not in ("auto", "on", "off"):
-        raise ValueError(f"BAGUA_OVERLAP must be auto|on|off, got {v!r}")
-    return v
+    return env_enum("BAGUA_OVERLAP")
 
 
 def get_overlap_chunk_bytes() -> int:
     """Target per-rank bytes of one independent ring sub-collective under
     the overlap scheduler; 0 (default) keeps the fused XLA collectives."""
-    return _int_env("BAGUA_OVERLAP_CHUNK_BYTES", 0)
+    return env_int("BAGUA_OVERLAP_CHUNK_BYTES")
+
+
+def get_max_exchange_period() -> int:
+    return env_int("BAGUA_MAX_EXCHANGE_PERIOD")
+
+
+def get_max_ring_chunks() -> int:
+    return env_int("BAGUA_MAX_RING_CHUNKS")
+
+
+def get_coordinator_addr() -> Optional[str]:
+    return _raw("BAGUA_COORDINATOR_ADDR")
+
+
+def get_comm_timeout_raw() -> Optional[str]:
+    """Raw watchdog timeout; the off-value semantics live in
+    :func:`bagua_tpu.watchdog.get_comm_timeout_s`.  None means UNSET —
+    an explicitly empty value passes through: ``""`` is one of the
+    watchdog's documented off-values, so collapsing it to None (the
+    default-300s path) would silently re-enable the watchdog."""
+    return os.environ.get("BAGUA_COMM_TIMEOUT_S")
 
 
 def get_bagua_service_port() -> int:
-    return _int_env("BAGUA_SERVICE_PORT", -1)
+    return env_int("BAGUA_SERVICE_PORT")
 
 
 def get_autotune_level() -> int:
-    return _int_env("BAGUA_AUTOTUNE", 0)
+    return env_int("BAGUA_AUTOTUNE")
 
 
 def get_autotune_max_samples() -> int:
-    return _int_env("BAGUA_AUTOTUNE_MAX_SAMPLES", 60)
+    return env_int("BAGUA_AUTOTUNE_MAX_SAMPLES")
 
 
 def get_autotune_sampling_confidence_time_s() -> float:
-    return float(os.environ.get("BAGUA_AUTOTUNE_SAMPLING_CONFIDENCE_TIME_S", 5.0))
+    return env_float("BAGUA_AUTOTUNE_SAMPLING_CONFIDENCE_TIME_S")
 
 
 def get_autotune_warmup_time_s() -> float:
-    return float(os.environ.get("BAGUA_AUTOTUNE_WARMUP_TIME_S", 30.0))
+    return env_float("BAGUA_AUTOTUNE_WARMUP_TIME_S")
 
 
 def is_autotune_algorithm_on() -> bool:
     """Let the autotuner search over algorithm families too (TPU extension;
     BASELINE.json wants centralized/low-precision selectable)."""
-    return _int_env("BAGUA_AUTOTUNE_ALGORITHM", 0) == 1
+    return env_bool("BAGUA_AUTOTUNE_ALGORITHM")
 
 
 def is_report_metrics_switch_on() -> bool:
-    return _int_env("BAGUA_REPORT_METRICS", 0) == 1
+    return env_bool("BAGUA_REPORT_METRICS")
 
 
 def is_output_autotune_log() -> bool:
-    return _int_env("BAGUA_IS_OUTPUT_AUTOTUNE_LOG", 0) == 1
+    return env_bool("BAGUA_IS_OUTPUT_AUTOTUNE_LOG")
 
 
-def get_autotune_server_addr() -> str | None:
+def get_autotune_server_addr() -> Optional[str]:
     return os.environ.get("AUTO_TUNE_SERVER_ADDR") or None
+
+
+def get_profile_dir() -> Optional[str]:
+    return _raw("BAGUA_PROFILE_DIR")
+
+
+def get_profile_steps_raw() -> str:
+    """Raw ``start:stop`` window; parsing (and the fallback on malformed
+    values) lives in :func:`bagua_tpu.profiling.profile_steps`."""
+    return env_str("BAGUA_PROFILE_STEPS")
+
+
+def is_flash_attention_enabled() -> bool:
+    return env_bool("BAGUA_FLASH_ATTENTION")
+
+
+def is_pallas_codec_disabled() -> bool:
+    return env_bool("BAGUA_DISABLE_PALLAS_CODEC")
+
+
+def get_elastic_join_window_s() -> float:
+    return env_float("BAGUA_ELASTIC_JOIN_WINDOW_S")
+
+
+def get_elastic_lease_ttl_s() -> float:
+    return env_float("BAGUA_ELASTIC_LEASE_TTL_S")
+
+
+def get_elastic_telemetry_out() -> Optional[str]:
+    return _raw("BAGUA_ELASTIC_TELEMETRY_OUT")
+
+
+def get_elastic_store_addr() -> Optional[str]:
+    return _raw("BAGUA_ELASTIC_STORE_ADDR")
+
+
+def get_elastic_epoch() -> int:
+    return env_int("BAGUA_ELASTIC_EPOCH")
+
+
+def get_elastic_node_id() -> int:
+    return env_int("BAGUA_ELASTIC_NODE_ID")
 
 
 #: env vars that register remote-accelerator PJRT plugins via sitecustomize;
@@ -132,3 +380,28 @@ def sanitize_cpu_sim_env(env: dict) -> dict:
     for var in ACCELERATOR_PLUGIN_ENV_VARS:
         env.pop(var, None)
     return env
+
+
+def render_env_vars_md() -> str:
+    """The ``docs/env_vars.md`` reference table, emitted straight from
+    :data:`ENV_REGISTRY` (``scripts/gen_env_docs.py`` writes/checks it)."""
+    lines = [
+        "# Environment variables",
+        "",
+        "Generated by `scripts/gen_env_docs.py` from "
+        "`bagua_tpu.env.ENV_REGISTRY` — do not edit by hand.",
+        "",
+        "Every `BAGUA_*` tunable is declared in the registry and read through",
+        "`bagua_tpu.env` accessors; `bagua-lint`'s `raw-env-read` rule fails",
+        "CI on any ad-hoc `os.environ` read of a `BAGUA_*` name elsewhere.",
+        "",
+        "| Variable | Type | Default | Description |",
+        "| --- | --- | --- | --- |",
+    ]
+    for name in sorted(ENV_REGISTRY):
+        v = ENV_REGISTRY[name]
+        typ = v.type if not v.choices else "|".join(v.choices)
+        default = v.default if v.default != "" else "*(unset)*"
+        doc = " ".join(v.doc.split())
+        lines.append(f"| `{name}` | {typ} | `{default}` | {doc} |")
+    return "\n".join(lines) + "\n"

@@ -29,7 +29,6 @@ sequence lengths, and under ``BAGUA_FLASH_ATTENTION=0``.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -362,7 +361,9 @@ def flash_attention_with_lse(q, k, v, *, causal: bool, block_q: int = 0,
 
 
 def _enabled() -> bool:
-    return os.environ.get("BAGUA_FLASH_ATTENTION", "1") != "0"
+    from .. import env
+
+    return env.is_flash_attention_enabled()
 
 
 # below this XLA's fused attention is already faster — re-validated r5 at

@@ -20,19 +20,22 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import os
 from typing import Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 
 def profile_dir() -> Optional[str]:
-    return os.environ.get("BAGUA_PROFILE_DIR") or None
+    from . import env
+
+    return env.get_profile_dir()
 
 
 def profile_steps() -> Tuple[int, int]:
     """[start, stop) step window for trainer auto-capture."""
-    raw = os.environ.get("BAGUA_PROFILE_STEPS", "2:5")
+    from . import env
+
+    raw = env.get_profile_steps_raw()
     try:
         start, stop = raw.split(":")
         return int(start), int(stop)

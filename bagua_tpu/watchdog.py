@@ -46,7 +46,9 @@ _OFF_VALUES = ("", "0", "off", "false", "no", "none")
 
 
 def get_comm_timeout_s() -> Optional[float]:
-    v = os.environ.get("BAGUA_COMM_TIMEOUT_S")
+    from . import env
+
+    v = env.get_comm_timeout_raw()
     if v is None:
         return DEFAULT_TIMEOUT_S
     if v.strip().lower() in _OFF_VALUES:

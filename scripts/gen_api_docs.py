@@ -74,6 +74,11 @@ MODULES = [
     "bagua_tpu.elastic.coordinator",
     "bagua_tpu.elastic.resize",
     "bagua_tpu.script.baguarun",
+    "bagua_tpu.analysis",
+    "bagua_tpu.analysis.ast_rules",
+    "bagua_tpu.analysis.jaxpr_check",
+    "bagua_tpu.analysis.findings",
+    "bagua_tpu.analysis.suppressions",
     "bagua_tpu.define",
     "bagua_tpu.utils",
 ]

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Generate docs/env_vars.md from the env-var registry.
+
+The table is emitted straight from ``bagua_tpu.env.ENV_REGISTRY`` — the same
+declaration the accessors read — so the reference cannot drift from the code.
+``bagua-lint``'s ``raw-env-read`` rule closes the loop: a ``BAGUA_*`` read
+outside the registry fails CI, so an undocumented tunable cannot exist.
+
+Usage: python scripts/gen_env_docs.py [--check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "docs", "env_vars.md")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="verify the committed table matches (exit 1 on drift)")
+    args = ap.parse_args()
+
+    from bagua_tpu.env import render_env_vars_md
+
+    text = render_env_vars_md()
+    if args.check:
+        old = open(OUT).read() if os.path.exists(OUT) else None
+        if old != text:
+            print("docs/env_vars.md out of date; regenerate with: "
+                  "python scripts/gen_env_docs.py")
+            return 1
+        print("docs/env_vars.md up to date")
+        return 0
+    with open(OUT, "w") as f:
+        f.write(text)
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,388 @@
+"""bagua-lint gates: AST rule fixtures, suppressions, the shrink-only
+baseline, and the jaxpr collective-consistency checker (seeded divergences +
+overlap-vs-serialized equivalence on the real step builders)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import bagua_tpu
+from bagua_tpu.analysis import Finding, run_ast_rules
+from bagua_tpu.analysis.ast_rules import analyze_source
+from bagua_tpu.analysis.findings import (
+    load_baseline,
+    save_baseline,
+    split_by_baseline,
+)
+from bagua_tpu.analysis.jaxpr_check import (
+    check_axis_binding,
+    check_equivalence,
+    collect,
+    make_family_tracer,
+    multiset,
+)
+from bagua_tpu.compat import shard_map
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.dirname(os.path.abspath(bagua_tpu.__file__))
+
+
+def rules_of(source, path="fixture.py"):
+    return [f.rule for f in analyze_source(path, textwrap.dedent(source))]
+
+
+# ---- AST rule fixtures (positive + negative per rule) ---------------------
+
+
+def test_host_sync_in_trace_positive():
+    found = rules_of("""
+        import jax
+        import numpy as np
+
+        def step(p, b):
+            def per_shard(p, b):
+                g = np.asarray(b)
+                jax.device_get(p)
+                v = float(g.sum())
+                b.block_until_ready()
+                return p
+            return jax.jit(per_shard)(p, b)
+    """)
+    assert found.count("host-sync-in-trace") == 4
+
+
+def test_host_sync_outside_trace_negative():
+    # the same calls on the host side are fine
+    found = rules_of("""
+        import jax
+        import numpy as np
+
+        def host_eval(p, b):
+            g = np.asarray(b)
+            jax.device_get(p)
+            return float(g.sum())
+    """)
+    assert "host-sync-in-trace" not in found
+
+
+def test_host_sync_jnp_negative():
+    found = rules_of("""
+        import jax
+        import jax.numpy as jnp
+
+        def step(p):
+            def traced(p):
+                return jnp.asarray(p)[None]
+            return jax.jit(traced)(p)
+    """)
+    assert "host-sync-in-trace" not in found
+
+
+def test_raw_env_read_positive():
+    found = rules_of("""
+        import os
+        a = os.environ.get("BAGUA_FIXTURE_X", "1")
+        b = os.environ["BAGUA_FIXTURE_Y"]
+        c = os.getenv("BAGUA_FIXTURE_Z")
+    """)
+    assert found.count("raw-env-read") == 3
+
+
+def test_raw_env_read_negative():
+    found = rules_of("""
+        import os
+        a = os.environ.get("HOME")
+        b = os.environ.get("XLA_FLAGS", "")
+    """)
+    assert "raw-env-read" not in found
+
+
+def test_raw_env_read_env_py_exempt():
+    found = [
+        f.rule
+        for f in analyze_source(
+            "bagua_tpu/env.py",
+            'import os\nv = os.environ.get("BAGUA_ANYTHING")\n',
+        )
+    ]
+    assert "raw-env-read" not in found
+
+
+def test_tracer_leak_positive_and_negative():
+    found = rules_of("""
+        import jax
+
+        class T:
+            def go(self):
+                def traced(x):
+                    self.cache = x
+                    return x * 2
+                return jax.jit(traced)
+
+            def host(self, x):
+                self.cache = x  # host-side stash is fine
+                return x
+    """)
+    assert found.count("tracer-leak") == 1
+
+
+def test_py_rng_in_trace_positive_and_negative():
+    found = rules_of("""
+        import jax
+        import random
+        import numpy as np
+
+        def step(p):
+            def traced(p):
+                a = random.random()
+                b = np.random.randn(3)
+                key = jax.random.PRNGKey(0)  # jax.random is fine
+                return p + a + b.sum()
+            return jax.jit(traced)(p)
+
+        seed = random.random()  # host-side RNG is fine
+    """)
+    assert found.count("py-rng-in-trace") == 2
+
+
+def test_dup_lambda_positive():
+    found = rules_of("""
+        import jax
+        import jax.numpy as jnp
+        f1 = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+        f2 = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+        f3 = lambda u: jax.tree.map(lambda x: jnp.asarray(x)[None], u)
+    """)
+    # arg-name normalization makes f3 a duplicate too; inner lambdas are
+    # not double-reported
+    assert found.count("dup-lambda") == 3
+
+
+def test_dup_lambda_negative_two_copies_and_trivial():
+    found = rules_of("""
+        import jax
+        import jax.numpy as jnp
+        f1 = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+        f2 = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+        k1 = lambda p: p
+        k2 = lambda p: p
+        k3 = lambda p: p
+    """)
+    assert "dup-lambda" not in found
+
+
+def test_torch_import_positive():
+    found = rules_of("""
+        import torch
+        from torch.utils.data import DataLoader
+    """)
+    assert found.count("torch-import") == 2
+
+
+# ---- suppressions ---------------------------------------------------------
+
+
+def test_suppression_trailing_and_standalone():
+    src = """
+        import os
+        a = os.environ.get("BAGUA_FIXTURE_A")  # bagua: lint-ignore[raw-env-read] -- fixture
+        # bagua: lint-ignore[raw-env-read] -- covers the next line
+        b = os.environ.get("BAGUA_FIXTURE_B")
+        c = os.environ.get("BAGUA_FIXTURE_C")
+    """
+    found = rules_of(src)
+    assert found.count("raw-env-read") == 1  # only c survives
+
+
+def test_suppression_wrong_rule_does_not_apply():
+    found = rules_of("""
+        import os
+        a = os.environ.get("BAGUA_FIXTURE_A")  # bagua: lint-ignore[tracer-leak] -- wrong id
+    """)
+    assert "raw-env-read" in found
+
+
+def test_suppression_without_reason_is_reported():
+    found = rules_of("""
+        import os
+        a = os.environ.get("BAGUA_FIXTURE_A")  # bagua: lint-ignore[raw-env-read]
+    """)
+    assert "bad-suppression" in found
+    assert "raw-env-read" in found  # the malformed suppression doesn't apply
+
+
+# ---- baseline -------------------------------------------------------------
+
+
+def test_baseline_round_trip_and_shrink_only(tmp_path):
+    f1 = Finding("raw-env-read", "a.py", 3, "m", text="x = 1")
+    f2 = Finding("raw-env-read", "b.py", 9, "m", text="y = 2")
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, [f1, f2])
+    baseline = load_baseline(path)
+
+    # same findings -> fully baselined, nothing new, nothing stale
+    new, old, stale = split_by_baseline([f1, f2], baseline)
+    assert not new and len(old) == 2 and not stale
+
+    # line drift does not churn the baseline (fingerprint is rule+path+text)
+    drifted = Finding("raw-env-read", "a.py", 30, "m", text="x = 1")
+    new, old, stale = split_by_baseline([drifted, f2], baseline)
+    assert not new and not stale
+
+    # a fixed violation leaves a STALE entry (shrink-only: must prune)
+    new, old, stale = split_by_baseline([f1], baseline)
+    assert not new and len(stale) == 1
+
+    # a new violation is NOT absorbed by the baseline
+    f3 = Finding("tracer-leak", "c.py", 1, "m", text="self.x = t")
+    new, old, stale = split_by_baseline([f1, f2, f3], baseline)
+    assert new == [f3]
+
+
+# ---- the repo itself is clean --------------------------------------------
+
+
+def test_package_has_no_unsuppressed_findings():
+    findings = run_ast_rules([PKG], rel_to=REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exits_zero_on_package():
+    out = subprocess.run(
+        [sys.executable, "-m", "bagua_tpu.analysis", "bagua_tpu/",
+         "--no-jaxpr"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_cli_flags_violations_and_baseline_flow(tmp_path):
+    bad = tmp_path / "bad_module.py"
+    bad.write_text(
+        'import os\nv = os.environ.get("BAGUA_FIXTURE_CLI")\n'
+    )
+    base = [sys.executable, "-m", "bagua_tpu.analysis", str(bad), "--no-jaxpr"]
+    env = {**os.environ, "PYTHONPATH": REPO}
+    out = subprocess.run(base, capture_output=True, text=True, timeout=120,
+                         cwd=str(tmp_path), env=env)
+    assert out.returncode == 1 and "raw-env-read" in out.stdout
+
+    # write a baseline, rerun: clean
+    bl = str(tmp_path / "bl.json")
+    subprocess.run(base + ["--write-baseline", "--baseline", bl],
+                   capture_output=True, text=True, timeout=120,
+                   cwd=str(tmp_path), env=env, check=True)
+    out = subprocess.run(base + ["--baseline", bl], capture_output=True,
+                         text=True, timeout=120, cwd=str(tmp_path), env=env)
+    assert out.returncode == 0, out.stdout
+
+    # fix the violation: the stale baseline entry now FAILS (shrink-only)
+    bad.write_text("v = 1\n")
+    out = subprocess.run(base + ["--baseline", bl], capture_output=True,
+                         text=True, timeout=120, cwd=str(tmp_path), env=env)
+    assert out.returncode == 1 and "STALE" in out.stdout
+
+
+# ---- jaxpr checker --------------------------------------------------------
+
+
+def _trace_shard_map(fn, n_args=1):
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    specs = (P("dp"),) * n_args
+    g = shard_map(fn, mesh=mesh, in_specs=specs, out_specs=P("dp"),
+                  check_vma=False)
+    args = [jnp.ones((8, 4)) for _ in range(n_args)]
+    jitted = jax.jit(g)
+    if hasattr(jitted, "trace"):
+        return jitted.trace(*args).jaxpr
+    return jax.make_jaxpr(g)(*args)
+
+
+def test_jaxpr_flags_mismatched_cond_collectives():
+    def bad(x):
+        return jax.lax.cond(
+            x.sum() > 0,
+            lambda v: jax.lax.psum(v, "dp"),
+            lambda v: v * 2.0,
+            x,
+        )
+
+    _, findings = collect(_trace_shard_map(bad))
+    assert [f.rule for f in findings] == ["cond-collective-divergence"]
+
+
+def test_jaxpr_accepts_matched_cond_collectives():
+    def good(x):
+        return jax.lax.cond(
+            x.sum() > 0,
+            lambda v: jax.lax.psum(v, "dp"),
+            lambda v: jax.lax.psum(v * 2.0, "dp"),
+            x,
+        )
+
+    seq, findings = collect(_trace_shard_map(good))
+    assert findings == []
+    # the shared branch collective is counted once, not per branch
+    assert [c.prim for c in seq] == ["psum"]
+
+
+def test_jaxpr_axis_binding():
+    def f(x):
+        return jax.lax.psum(x, "dp")
+
+    seq, _ = collect(_trace_shard_map(f))
+    assert check_axis_binding(seq, ("dp",)) == []
+    bad = check_axis_binding(seq, ("inter", "intra"))
+    assert [b.rule for b in bad] == ["unbound-mesh-axis"]
+
+
+@pytest.mark.parametrize("family", ["gradient_allreduce", "zero", "bytegrad"])
+@pytest.mark.parametrize("accum", [1, 4])
+def test_overlap_vs_serialized_collective_equivalence(family, accum):
+    """PR 2's 'paths cannot drift' claim as a checked invariant, on the REAL
+    step builders."""
+    findings, report = check_equivalence(
+        family, accum, make_family_tracer(family, accum)
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert report["equal"]
+    # byte accounting covered every bucket with at least one collective
+    for row in report["serialized"]["buckets"]:
+        assert row["collectives"], row
+
+
+def test_equivalence_catches_seeded_divergence():
+    """A construction with one extra collective must be flagged."""
+    tracer = make_family_tracer("gradient_allreduce", 1)
+    trainer, jaxpr_off = tracer("off")
+
+    def extra(x):
+        return jax.lax.psum(jax.lax.psum(x, "dp"), "dp")
+
+    divergent = _trace_shard_map(extra)
+
+    def fake_tracer(mode):
+        return trainer, (jaxpr_off if mode == "off" else divergent)
+
+    findings, report = check_equivalence("gradient_allreduce", 1, fake_tracer)
+    assert not report["equal"]
+    assert "overlap-serialized-divergence" in [f.rule for f in findings]
+
+
+def test_multiset_ignores_order_but_not_shape():
+    a = _trace_shard_map(lambda x: jax.lax.psum(x, "dp"))
+    b = _trace_shard_map(lambda x: jax.lax.psum(x * 2.0, "dp"))
+    sa, _ = collect(a)
+    sb, _ = collect(b)
+    assert multiset(sa) == multiset(sb)  # same signature, different compute
+    c = _trace_shard_map(lambda x: jax.lax.psum(x[:, :2], "dp"))
+    sc, _ = collect(c)
+    assert multiset(sa) != multiset(sc)  # shape is part of the signature

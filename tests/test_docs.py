@@ -18,10 +18,22 @@ def test_api_reference_up_to_date():
     assert out.returncode == 0, out.stdout + out.stderr
 
 
+def test_env_vars_reference_up_to_date():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "gen_env_docs.py"),
+         "--check"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
 def test_doc_pages_exist():
     for page in (
         "docs/index.md",
         "docs/api/index.md",
+        "docs/analysis.md",
+        "docs/env_vars.md",
         "docs/tutorials/porting.md",
         "docs/tutorials/performance.md",
     ):
