@@ -49,6 +49,30 @@ class AlgorithmContext:
     #: target per-rank bytes of one independent ring sub-collective; None
     #: keeps the fused psum/psum_scatter primitives (no chunking)
     overlap_chunk_bytes: Optional[int] = None
+    #: flat-resident layout active: params/grads/opt state trees handed to
+    #: the algorithm stages are ``{"flats": (...), "local": {...}}`` bucket
+    #: containers, NOT leaf pytrees — reach their flat buffers through
+    #: :meth:`bucket_flats` / :meth:`from_bucket_flats` so one stage
+    #: implementation serves both layouts
+    flat_resident: bool = False
+
+    def bucket_flats(self, tree) -> List:
+        """The per-bucket flat gradient/param/state buffers of ``tree``
+        under the active layout: the resident flats themselves (already
+        bucket-flat — zero repacking), or the traced flatten of a leaf
+        pytree.  The ONE accessor algorithm stages use, so the resident
+        layout cannot silently re-pay the per-step flatten it removed."""
+        if self.flat_resident:
+            return list(tree["flats"])
+        return self.plan.flatten_tree(tree)
+
+    def from_bucket_flats(self, flats, like):
+        """Inverse of :meth:`bucket_flats`: rebuild ``like``'s layout from
+        per-bucket flat buffers — a no-copy container under the resident
+        layout, the traced unflatten for leaf pytrees."""
+        if self.flat_resident:
+            return {"flats": tuple(flats), "local": like["local"]}
+        return self.plan.unflatten_tree(flats, like)
 
     def hierarchical_allreduce(self, flat, op: ReduceOp, hierarchical: bool):
         """Hierarchical = intra-node stage then inter-node stage, the reference's
@@ -145,6 +169,18 @@ class Algorithm:
     #: measured record (BENCH_OVERLAP.json) shows the serialized path
     #: faster despite the family supporting the contract.
     overlap_auto: bool = True
+    #: Flat-resident contract: when True the trainer may keep params /
+    #: grads / optimizer state as bucket-flat buffers across steps
+    #: (``{"flats", "local"}`` containers) and every traced stage must go
+    #: through :meth:`AlgorithmContext.bucket_flats` /
+    #: :meth:`AlgorithmContext.from_bucket_flats` instead of touching leaf
+    #: pytrees.  Families whose stages inspect leaf shapes stay False and
+    #: always run the leaf layout.
+    supports_flat_resident: bool = False
+    #: Whether ``flat_resident="auto"`` may pick the resident layout for
+    #: this family (explicit ``flat_resident="on"`` always wins) — the
+    #: measured-record gate, like :attr:`overlap_auto` (BENCH_FLAT.json).
+    flat_resident_auto: bool = True
 
     def need_reset(self, step: int) -> bool:
         """Host-side: return True to rebuild buckets/recompile (reference
@@ -205,9 +241,10 @@ class Algorithm:
                            algo_state, step):
         """Assemble the post-communication gradient representation from the
         per-bucket :meth:`reduce_bucket_grad` results (the overlap path's
-        replacement for :meth:`process_grads`).  Default: unflatten the
-        reduced buckets back into the gradient tree."""
-        return ctx.plan.unflatten_tree(reduced, grads), algo_state
+        replacement for :meth:`process_grads`).  Default: rebuild the
+        gradient layout from the reduced buckets — the resident flat
+        container under flat residency, the leaf unflatten otherwise."""
+        return ctx.from_bucket_flats(reduced, grads), algo_state
 
     def process_grads_bucketed(self, ctx: AlgorithmContext, grads, params,
                                algo_state, step):
@@ -215,11 +252,29 @@ class Algorithm:
         the same per-bucket reduction the overlap scheduler streams, issued
         after the full backward — one implementation, so the two paths
         cannot drift numerically.  Dense families alias ``process_grads``
-        to this."""
-        flats = ctx.plan.flatten_tree(grads)
+        to this.  Under the flat-resident layout the grads already ARE the
+        bucket flats, so this stage communicates them with zero repacking."""
+        flats = ctx.bucket_flats(grads)
         reduced = [self.reduce_bucket_grad(ctx, i, f)
                    for i, f in enumerate(flats)]
         return self.grads_from_reduced(ctx, reduced, grads, algo_state, step)
+
+    # ---- flat-resident layout hooks (supports_flat_resident families) ----
+
+    def relayout_algo_state(self, old_plan, new_plan, algo_state):
+        """Migrate plan-keyed algorithm state when the trainer re-buckets
+        resident flat state (autotune / overlap-readiness re-bucketing,
+        cross-plan checkpoint restore).  Families whose state holds flat
+        bucket buffers (gossip peer replicas) override with a
+        :func:`bagua_tpu.bucket.relayout_flats` pass; param-shaped or empty
+        state needs no migration."""
+        if algo_state is None:
+            return None
+        raise NotImplementedError(
+            f"{type(self).__name__} carries algorithm state but does not "
+            "implement relayout_algo_state; re-bucketing its flat-resident "
+            "state would corrupt plan-keyed buffers"
+        )
 
     def process_pre_step(self, ctx: AlgorithmContext, params, algo_state, step):
         """Weight transformation after backward, before the optimizer update

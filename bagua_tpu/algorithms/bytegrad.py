@@ -20,6 +20,9 @@ from .base import Algorithm, AlgorithmContext
 class ByteGradAlgorithm(Algorithm):
     name = "bytegrad"
     supports_overlap = True
+    #: the codec pipeline already runs on flat buckets, so the resident
+    #: layout feeds it with zero repacking (BENCH_FLAT.json)
+    supports_flat_resident = True
     #: measured (BENCH_OVERLAP.json, 8-dev cpu-sim mesh): the overlap
     #: restructure was never clearly faster for the codec pipeline
     #: (0.69-0.95x in early block runs, noise-bound under interleaved

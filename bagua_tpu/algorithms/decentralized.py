@@ -51,6 +51,10 @@ def shift_one_peer(rank: int, nranks: int, step: int) -> int:
 
 class DecentralizedAlgorithm(Algorithm):
     replicated_params = False
+    #: the gossip exchange already runs on flat buckets; under the
+    #: resident layout the weights ARE those buckets, so the exchange (and
+    #: the tracked peer replicas) needs no per-step flatten at all
+    supports_flat_resident = True
 
     def __init__(
         self,
@@ -107,7 +111,7 @@ class DecentralizedAlgorithm(Algorithm):
         return (flat + peer_val) * 0.5
 
     def process_pre_step(self, ctx: AlgorithmContext, params, algo_state, step):
-        flats = ctx.plan.flatten_tree(params)
+        flats = ctx.bucket_flats(params)
 
         def do_comm(fs):
             return [self._exchange(ctx, f, step) for f in fs]
@@ -137,11 +141,23 @@ class DecentralizedAlgorithm(Algorithm):
             peer = flats
         if self.track_peer_weights:
             algo_state = {"peer_weights": peer}
-        return ctx.plan.unflatten_tree(flats, params), algo_state
+        return ctx.from_bucket_flats(flats, params), algo_state
+
+    def relayout_algo_state(self, old_plan, new_plan, algo_state):
+        if algo_state is None:
+            return None
+        from ..bucket import relayout_flats
+
+        return {"peer_weights": relayout_flats(
+            old_plan, new_plan, algo_state["peer_weights"]
+        )}
 
 
 class LowPrecisionDecentralizedAlgorithm(Algorithm):
     replicated_params = False
+    #: the compressed ring exchange and its three weight replicas are
+    #: flat-bucket-shaped already; the resident layout feeds them directly
+    supports_flat_resident = True
 
     def __init__(self, hierarchical: bool = True, communication_interval: int = 1):
         """
@@ -203,7 +219,7 @@ class LowPrecisionDecentralizedAlgorithm(Algorithm):
         return x_new, left, right, x_new
 
     def process_post_step(self, ctx: AlgorithmContext, params, algo_state, step):
-        flats = ctx.plan.flatten_tree(params)
+        flats = ctx.bucket_flats(params)
 
         def do_comm(operand):
             fs, st = operand
@@ -225,4 +241,14 @@ class LowPrecisionDecentralizedAlgorithm(Algorithm):
             )
         else:
             flats, algo_state = do_comm((flats, algo_state))
-        return ctx.plan.unflatten_tree(flats, params), algo_state
+        return ctx.from_bucket_flats(flats, params), algo_state
+
+    def relayout_algo_state(self, old_plan, new_plan, algo_state):
+        if algo_state is None:
+            return None
+        from ..bucket import relayout_flats
+
+        return {
+            k: relayout_flats(old_plan, new_plan, algo_state[k])
+            for k in ("left", "right", "self")
+        }

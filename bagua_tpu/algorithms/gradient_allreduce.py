@@ -19,6 +19,10 @@ from .base import Algorithm, AlgorithmContext
 class GradientAllReduceAlgorithm(Algorithm):
     name = "gradient_allreduce"
     supports_overlap = True
+    #: the per-bucket allreduce consumes resident bucket flats directly
+    #: (zero repacking) — measured on-par-to-faster than the leaf layout
+    #: on the cpu-sim mesh (BENCH_FLAT.json), so ``auto`` takes it
+    supports_flat_resident = True
 
     def __init__(
         self,

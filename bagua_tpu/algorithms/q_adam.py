@@ -35,6 +35,10 @@ class QAdamOptState(NamedTuple):
 class QAdamAlgorithm(Algorithm):
     name = "qadam"
     owns_optimizer = True
+    #: the momenta are elementwise maps of the gradient, so they live as
+    #: bucket flats under the resident layout and the compressed momentum
+    #: pipeline consumes them with zero repacking
+    supports_flat_resident = True
 
     def __init__(
         self,
@@ -88,9 +92,9 @@ class QAdamAlgorithm(Algorithm):
     def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
         if self._compressed:
             return grads, algo_state
-        flats = ctx.plan.flatten_tree(grads)
+        flats = ctx.bucket_flats(grads)
         flats = [ctx.hierarchical_allreduce(f, ReduceOp.AVG, False) for f in flats]
-        return ctx.plan.unflatten_tree(flats, grads), algo_state
+        return ctx.from_bucket_flats(flats, grads), algo_state
 
     # ---- optimizer -------------------------------------------------------
 
@@ -99,7 +103,7 @@ class QAdamAlgorithm(Algorithm):
         return QAdamOptState(exp_avg=zeros, exp_avg_sq=jax.tree.map(jnp.zeros_like, params))
 
     def _communicate_momentum(self, ctx: AlgorithmContext, exp_avg):
-        flats = ctx.plan.flatten_tree(exp_avg)
+        flats = ctx.bucket_flats(exp_avg)
         use_hier = (
             self.hierarchical
             and ctx.internode is not None
@@ -115,7 +119,7 @@ class QAdamAlgorithm(Algorithm):
             elif ctx.comm.nranks() > 1:
                 f = compressed_scatter_gather_allreduce(ctx.comm, f, average=True)
             out.append(f)
-        return ctx.plan.unflatten_tree(out, exp_avg)
+        return ctx.from_bucket_flats(out, exp_avg)
 
     def optimizer_update(self, ctx, params, grads, opt_state: QAdamOptState, algo_state, step):
         beta1, beta2 = self.betas

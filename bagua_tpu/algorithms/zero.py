@@ -67,6 +67,11 @@ class ZeroOptimizerAlgorithm(Algorithm):
 
     owns_optimizer = True
     sharded_opt_state = True
+    #: flat residency is ZeRO's native pure-dp layout (this is where the
+    #: machinery was born — the measured ~7% leaf->flat->leaf round trip,
+    #: VERDICT r3 #4); ``flat_resident="off"`` opts back into the leaf
+    #: layout, which model-parallel compositions use regardless
+    supports_flat_resident = True
     #: overlap contract (flat-resident layout only — the trainer gates on
     #: ``_zero_flat``): the per-bucket reduce-scatter is issued inside the
     #: overlap window and ``optimizer_update`` consumes the pre-reduced

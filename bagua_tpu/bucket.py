@@ -168,3 +168,107 @@ class BucketPlan:
         from .tensor import tree_from_named
 
         return tree_from_named(tree_like, self.unflatten_to_named(flats))
+
+    # ---- layout portability ------------------------------------------
+
+    def layout_descriptor(self) -> List[dict]:
+        """JSON-serializable description of the flat layout — enough to
+        rebuild an equivalent plan (:meth:`from_layout_descriptor`) on a
+        process that never saw the original params.  Stored in checkpoint
+        layout sidecars so a flat-resident checkpoint saved under one plan
+        can be re-laid-out under another on restore."""
+        return [
+            {
+                "alignment": int(b.alignment),
+                "tensors": [
+                    {
+                        "name": t.name,
+                        "shape": [int(d) for d in t.shape],
+                        "dtype": np.dtype(t.dtype).name,
+                    }
+                    for t in b.tensors
+                ],
+            }
+            for b in self.buckets
+        ]
+
+    @staticmethod
+    def from_layout_descriptor(desc: Sequence[dict]) -> "BucketPlan":
+        """Rebuild a plan from :meth:`layout_descriptor` output.  The
+        reconstructed :class:`NamedParam` entries carry empty tree paths —
+        sufficient for every flat-layout operation (flatten / unflatten /
+        relayout key on names, shapes, and dtypes only)."""
+        specs = []
+        for i, b in enumerate(desc):
+            tensors = tuple(
+                NamedParam(
+                    name=t["name"],
+                    path=(),
+                    shape=tuple(int(d) for d in t["shape"]),
+                    dtype=np.dtype(t["dtype"]),
+                )
+                for t in b["tensors"]
+            )
+            specs.append(
+                BucketSpec(name=str(i), tensors=tensors,
+                           alignment=int(b["alignment"]))
+            )
+        return BucketPlan(buckets=tuple(specs))
+
+
+def relayout_flats(
+    old_plan: BucketPlan, new_plan: BucketPlan, flats: Sequence[jax.Array]
+) -> List[jax.Array]:
+    """Migrate flat bucket buffers from ``old_plan``'s layout to
+    ``new_plan``'s WITHOUT materializing leaf shapes: per-tensor 1-D
+    segments are sliced out of the old flats and concatenated straight into
+    the new ones (old padding dropped, new padding zero-filled).  This is
+    the flat->flat path autotune re-bucketing and cross-plan checkpoint
+    restores use to move flat-RESIDENT training state, so the per-step
+    round-trip the resident layout removed never sneaks back in at
+    migration points.
+
+    Segments slice along the LAST axis, so stacked per-rank state (gossip
+    families carry flats with a leading rank axis) migrates with the same
+    code path.  Both plans must cover the same tensor names."""
+    segments: Dict[str, jax.Array] = {}
+    seg_numel: Dict[str, int] = {}
+    for b, flat in zip(old_plan.buckets, flats):
+        for t, off in zip(b.tensors, b.offsets()):
+            segments[t.name] = jax.lax.slice_in_dim(
+                flat, off, off + t.numel, axis=-1
+            )
+            seg_numel[t.name] = t.numel
+    missing = [
+        t.name for b in new_plan.buckets for t in b.tensors
+        if t.name not in segments
+    ]
+    if missing:
+        raise ValueError(
+            f"relayout_flats: old plan misses tensors {sorted(missing)}"
+        )
+    resized = {
+        t.name: (seg_numel[t.name], t.numel)
+        for b in new_plan.buckets for t in b.tensors
+        if seg_numel[t.name] != t.numel
+    }
+    if resized:
+        # a silently-shifted offset would corrupt every later tensor in
+        # the bucket (worst case: equal total lengths, no error at all)
+        raise ValueError(
+            "relayout_flats: tensor sizes differ between plans — the "
+            "flat buffers cannot be re-laid-out (model edit between "
+            "save and restore?): "
+            + ", ".join(f"{n}: {a} -> {b} elems"
+                        for n, (a, b) in sorted(resized.items()))
+        )
+    out: List[jax.Array] = []
+    for b in new_plan.buckets:
+        parts = [segments[t.name].astype(b.dtype) for t in b.tensors]
+        if b.padding:
+            pad_shape = parts[0].shape[:-1] + (b.padding,)
+            parts.append(jnp.zeros(pad_shape, dtype=b.dtype))
+        out.append(
+            jnp.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
+        )
+    return out

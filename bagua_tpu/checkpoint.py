@@ -183,8 +183,60 @@ class BaguaCheckpointManager:
             return None
         return json.loads(path.read_text())
 
-    @staticmethod
-    def _check_layout(saved: Optional[dict], expected: Optional[dict]) -> None:
+    def read_layout(self, step: int) -> Optional[dict]:
+        """The layout sidecar saved with ``step`` (None when the step was
+        saved without ``metadata=``).  ``BaguaTrainer.restore_checkpoint``
+        reads this to decide whether a flat-resident checkpoint needs a
+        relayout or leaf conversion before it can feed the live trainer."""
+        return self._read_layout(int(step))
+
+    #: metadata keys that carry layout PAYLOAD (the full bucket layout
+    #: descriptor), not compatibility constraints — never compared
+    _LAYOUT_PAYLOAD_KEYS = ("flat_layout", "stacked")
+
+    @classmethod
+    def _normalize_layout(cls, meta: Optional[dict]) -> Optional[dict]:
+        if meta is None:
+            return None
+        m = {k: v for k, v in meta.items()
+             if k not in cls._LAYOUT_PAYLOAD_KEYS}
+        if m.get("layout") == "zero_flat":
+            # pre-r6 sidecars named the (then ZeRO-only) flat-resident
+            # layout "zero_flat"; it is the same on-disk layout
+            m["layout"] = "flat"
+        return m
+
+    @classmethod
+    def _check_layout(cls, saved: Optional[dict],
+                      expected: Optional[dict]) -> None:
+        # gossip state carries a leading rank axis, so ITS shapes depend on
+        # the world size even under an identical plan — read before
+        # normalization strips the payload keys
+        stacked = bool((saved or {}).get("stacked")) or bool(
+            (expected or {}).get("stacked")
+        )
+        saved = cls._normalize_layout(saved)
+        expected = cls._normalize_layout(expected)
+        if (
+            saved is not None
+            and expected is not None
+            and saved.get("plan_signature")
+            and saved.get("plan_signature") == expected.get("plan_signature")
+        ):
+            # the signature pins the CONCRETE layout (tensor order, dtypes,
+            # alignment padding): the bucket_bytes KNOB may differ while
+            # splitting identically (small models land in the same buckets
+            # under many sizes), and — for UNSTACKED state — a world-size
+            # change leaves alignment-1 flat buffers byte-identical (an
+            # elastic resume of the default allreduce layout).
+            # ``opt_shards`` — the key that pins sharded (ZeRO) chunk-state
+            # stacking — is still compared, so topology changes that DO
+            # reshape state keep raising.
+            keys = ("bucket_bytes",) if stacked else ("bucket_bytes",
+                                                      "world_size")
+            for k in keys:
+                saved.pop(k, None)
+                expected.pop(k, None)
         if expected is None:
             if saved is not None and saved.get("plan_dependent"):
                 logger.warning(
@@ -235,14 +287,16 @@ class BaguaCheckpointManager:
             return
         raise ValueError(
             "checkpoint layout mismatch — this checkpoint cannot restore "
-            f"into the current trainer ({detail}).  The flat-resident "
-            "ZeRO layout is bucket-plan- and world-size-dependent: an "
+            f"directly into the current trainer ({detail}).  Flat-resident "
+            "layouts are bucket-plan- and world-size-dependent: an "
             "elastic restart at a different process count or a "
             "bucket_bytes change produces different flat-buffer shapes.  "
-            "Either restart with the original world size/bucket_bytes, "
-            "or re-save the checkpoint in the plan-independent leaf "
-            "layout (trainer.unstack_params(state)) before changing the "
-            "topology."
+            "Use trainer.restore_checkpoint(manager, state_like) — it "
+            "re-lays-out or leaf-converts flat checkpoints across plans "
+            "(sharded-opt-state ZeRO excepted) — or restart with the "
+            "original world size/bucket_bytes, or re-save in the "
+            "plan-independent leaf layout "
+            "(trainer.unstack_params(state)) before changing the topology."
         )
 
     def restore(

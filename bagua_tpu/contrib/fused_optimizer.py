@@ -16,26 +16,47 @@ way the reference composes with ``with_bagua`` (any
     tx = fuse_optimizer(optax.adam(1e-3))
     trainer = BaguaTrainer(loss_fn, tx, GradientAllReduceAlgorithm())
 
+Under the trainer's FLAT-RESIDENT layout (``flat_resident=`` /
+``BAGUA_FLAT_RESIDENT``, see docs/flat_layout.md) the params already live as
+bucket-flat buffers, which IS the fused layout — so the trainer unwraps the
+returned transformation (:attr:`FusedTransformation.fused_inner`) and runs
+the inner optimizer on the bucket flats natively: no per-step concat, no
+per-leaf slicing, and the private per-dtype grouping below never traces.
+The wrapper's own flatten/unflatten only runs in the leaf layout.
+
 Exact step-equality with the unfused optimizer holds for elementwise
 transforms (sgd, momentum, adam, adamw with uniform weight decay, ...) —
-the same caveat as the reference's storage flattening.  Transforms that
-inspect per-parameter shapes (e.g. factored second moments) change meaning
-when fused; don't wrap those.
+the same caveat as the reference's storage flattening, and the same one the
+flat-resident layout inherits (whether the buffers are grouped per dtype or
+per bucket).  Transforms that inspect per-parameter shapes (e.g. factored
+second moments) change meaning when fused; don't wrap those.
 """
 
 from __future__ import annotations
 
-from typing import Any, List, NamedTuple, Tuple
+from typing import Any, Callable, List, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
-__all__ = ["fuse_optimizer", "FusedOptimizer"]
+__all__ = ["fuse_optimizer", "FusedOptimizer", "FusedTransformation"]
 
 
 class _FusedState(NamedTuple):
     inner: Any
+
+
+class FusedTransformation(NamedTuple):
+    """An ``optax.GradientTransformation``-shaped pair that also exposes the
+    wrapped transform, so the trainer's flat-resident layout can run it on
+    bucket flats directly instead of through the per-dtype flatten below."""
+
+    init: Callable
+    update: Callable
+    #: the unfused inner transform ``fuse_optimizer`` wrapped
+    fused_inner: optax.GradientTransformation
 
 
 def _group_leaves(tree) -> Tuple[List[str], dict]:
@@ -58,25 +79,30 @@ def _flatten(tree) -> dict:
 
 
 def _unflatten(flat: dict, like) -> Any:
-    """{dtype_name: buffer} -> pytree with ``like``'s structure/shapes."""
+    """{dtype_name: buffer} -> pytree with ``like``'s structure/shapes.
+
+    One static ``jnp.split`` at precomputed offsets per dtype buffer: the
+    split points are compile-time constants, so XLA sees plain fusable
+    slices — not the O(leaves) ``dynamic_slice`` ops an index-by-index
+    unpack would emit, which is exactly the program bloat this module
+    exists to avoid."""
     leaves = jax.tree_util.tree_leaves(like)
     treedef = jax.tree_util.tree_structure(like)
     _, groups = _group_leaves(like)
     out: List[Any] = [None] * len(leaves)
     for k, idxs in groups.items():
-        buf, offset = flat[k], 0
-        for i in idxs:
-            n = leaves[i].size
-            out[i] = jax.lax.dynamic_slice_in_dim(buf, offset, n).reshape(
-                leaves[i].shape
-            )
-            offset += n
+        offsets = np.cumsum([leaves[i].size for i in idxs])[:-1]
+        parts = (
+            jnp.split(flat[k], offsets) if len(idxs) > 1 else [flat[k]]
+        )
+        for i, seg in zip(idxs, parts):
+            out[i] = seg.reshape(leaves[i].shape)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def fuse_optimizer(
     inner: optax.GradientTransformation,
-) -> optax.GradientTransformation:
+) -> FusedTransformation:
     """Wrap ``inner`` to run over per-dtype flattened buffers."""
 
     def init_fn(params):
@@ -90,7 +116,7 @@ def fuse_optimizer(
         )
         return _unflatten(flat_out, updates), _FusedState(inner_state)
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    return FusedTransformation(init_fn, update_fn, inner)
 
 
 # reference-compatible name

@@ -59,6 +59,13 @@ _declare("BAGUA_OVERLAP", "enum", "auto",
 _declare("BAGUA_OVERLAP_CHUNK_BYTES", "int", "0",
          "Target per-rank bytes of one independent ring sub-collective under "
          "the overlap scheduler; 0 keeps the fused XLA collectives.")
+_declare("BAGUA_FLAT_RESIDENT", "enum", "auto",
+         "Flat-resident training state: keep params/grads/optimizer state "
+         "as bucket-flat buffers across steps (`on`), keep the leaf pytree "
+         "layout (`off`), or engage it wherever the algorithm family "
+         "supports it on a pure-data-parallel mesh (`auto`, see "
+         "docs/flat_layout.md and BENCH_FLAT.json).",
+         choices=("auto", "on", "off"))
 _declare("BAGUA_MAX_EXCHANGE_PERIOD", "int", "128",
          "Largest step-pairing period precompiled into one program by "
          "`exchange_with_peer` (compile-size guard for pod-scale gossip).")
@@ -265,6 +272,13 @@ def get_overlap_chunk_bytes() -> int:
     """Target per-rank bytes of one independent ring sub-collective under
     the overlap scheduler; 0 (default) keeps the fused XLA collectives."""
     return env_int("BAGUA_OVERLAP_CHUNK_BYTES")
+
+
+def get_flat_resident_mode() -> str:
+    """Flat-resident training state: ``auto`` (default — engage wherever
+    the algorithm family supports it on a pure-dp mesh), ``on``, or
+    ``off`` (the leaf pytree layout)."""
+    return env_enum("BAGUA_FLAT_RESIDENT")
 
 
 def get_max_exchange_period() -> int:

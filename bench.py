@@ -727,6 +727,10 @@ def main():
                     help="measure the overlap scheduler on vs off (img/s + "
                          "profiler comm-hidden ratio), writing "
                          "BENCH_OVERLAP.json")
+    ap.add_argument("--flat", action="store_true",
+                    help="measure the flat-resident state layout on vs off "
+                         "(throughput + fused-optimizer compile audit), "
+                         "writing BENCH_FLAT.json")
     ap.add_argument("--only", default=None,
                     help="re-measure ONE record through the driver and "
                          "update it in BENCH_SUITE.json (a family name, or "
@@ -743,6 +747,12 @@ def main():
         from benchmarks.overlap_bench import run_suite
 
         run_suite("BENCH_OVERLAP.json")
+        return
+
+    if args.flat:
+        from benchmarks.flat_resident_bench import run_suite
+
+        run_suite("BENCH_FLAT.json")
         return
 
     from bagua_tpu.parallel.mesh import build_mesh

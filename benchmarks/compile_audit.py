@@ -112,15 +112,88 @@ def audit_flagship(n_devices):
     trace_s = _time.time() - t0
     t1 = _time.time()
     lowered.compile()
+    text = lowered.as_text()
     rec = {
         "family": "flagship_transformer_dp_tp",
         "n_devices": n_devices,
         "trace_s": round(trace_s, 3),
         "compile_s": round(_time.time() - t1, 3),
-        "stablehlo_bytes": len(lowered.as_text()),
+        "stablehlo_bytes": len(text),
+        "hlo_op_count": _hlo_op_count(text),
     }
     print(json.dumps(rec), flush=True)
     return [rec]
+
+
+def _hlo_op_count(stablehlo_text: str) -> int:
+    """Rough-but-stable program size proxy: one per op-result assignment in
+    the StableHLO module text.  Tracks exactly the growth the fused
+    optimizer exists to kill (thousands of tiny per-leaf update ops)."""
+    return sum(1 for line in stablehlo_text.splitlines() if " = " in line)
+
+
+def audit_fused_optimizer_layouts(n_layers: int = 24):
+    """Compile the SAME fused-adam train step in the leaf layout vs the
+    flat-resident layout and record program size + compile time.
+
+    The workload is a deep narrow MLP (``2 + 2*n_layers`` param leaves), the
+    shape where per-leaf optimizer math bloats the program: the leaf layout
+    pays the fused wrapper's per-dtype flatten/unflatten every step, while
+    the flat-resident layout runs the inner adam straight on the resident
+    bucket flats (the wrapper is unwrapped — zero repacking in the HLO).
+    Records land in BENCH_FLAT.json via flat_resident_bench."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bagua_tpu
+    from bagua_tpu.algorithms import GradientAllReduceAlgorithm
+    from bagua_tpu.contrib import fuse_optimizer
+    from bagua_tpu.models.mlp import MLP
+
+    model = MLP(features=(32,) * n_layers + (8,))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16)))["params"]
+    n_dev = len(jax.devices())
+    batch = {
+        "x": jnp.zeros((n_dev * 2, 16), jnp.float32),
+        "y": jnp.zeros((n_dev * 2,), jnp.int32),
+    }
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    records = []
+    for layout in ("leaf", "flat"):
+        trainer = bagua_tpu.BaguaTrainer(
+            loss_fn, fuse_optimizer(optax.adam(1e-3)),
+            GradientAllReduceAlgorithm(), bucket_bytes=16384, autotune=False,
+            flat_resident="off" if layout == "leaf" else "on",
+        )
+        t0 = time.time()
+        state = trainer.init(params)
+        gbatch = trainer.shard_batch(batch)
+        fn = trainer._get_step_fn()
+        lowered = fn.lower(state, gbatch)
+        trace_s = time.time() - t0
+        t1 = time.time()
+        lowered.compile()
+        text = lowered.as_text()
+        records.append({
+            "metric": f"compile_audit_fused_adam_{layout}",
+            "family": "gradient_allreduce_fused_adam",
+            "layout": layout,
+            "n_devices": n_dev,
+            "param_leaves": len(jax.tree_util.tree_leaves(params)),
+            "trace_s": round(trace_s, 3),
+            "compile_s": round(time.time() - t1, 3),
+            "stablehlo_bytes": len(text),
+            "hlo_op_count": _hlo_op_count(text),
+        })
+        print(json.dumps(records[-1]), flush=True)
+    return records
 
 
 def audit(n_devices, families):
@@ -152,12 +225,14 @@ def audit(n_devices, families):
         t1 = time.time()
         lowered.compile()
         compile_s = time.time() - t1
+        text = lowered.as_text()
         rec = {
             "family": family,
             "n_devices": n_devices,
             "trace_s": round(trace_s, 3),
             "compile_s": round(compile_s, 3),
-            "stablehlo_bytes": len(lowered.as_text()),
+            "stablehlo_bytes": len(text),
+            "hlo_op_count": _hlo_op_count(text),
         }
         print(json.dumps(rec), flush=True)
         records.append(rec)

@@ -193,42 +193,26 @@ def run_suite(out_path: str = "BENCH_OVERLAP.json",
         records.append(rec)
         return rec
 
+    from benchmarks._ab import interleaved_ab, speedup_record
+
     gate = {}
     for family, accum, chunk in CONFIGS:
-        # INTERLEAVED A/B trials (off, on, off, on, ...): on a shared host
-        # the two paths drift with background load if measured back-to-back
-        # in blocks — interleaving puts each pair under the same
-        # interference.  The winner is the MEDIAN per-trial ratio (robust
-        # to the 2-5x one-off stalls this host produces), and the full
-        # per-trial spread is recorded so a noise-bound comparison reads
-        # as one instead of as a result.
+        # interleaved A/B best-of-trials protocol (see benchmarks/_ab.py —
+        # shared with flat_resident_bench)
         trials = 5
-        ratios, off, on = [], None, None
-        for _ in range(trials):
-            o = measure(family, accum, "off", chunk, repeats=1)
-            n = measure(family, accum, "on", chunk, repeats=1)
-            ratios.append(round(n["value"] / o["value"], 3))
-            off = o if off is None or o["value"] > off["value"] else off
-            on = n if on is None or n["value"] > on["value"] else on
-        for rec in (off, on):
-            rec["timing"] = (
-                f"best_of_{trials}_interleaved_ab_trials_"
-                "min_of_2_windows_x" + rec["timing"].rsplit("x", 1)[1]
-            )
+        off, on, ratios = interleaved_ab(
+            lambda: measure(family, accum, "off", chunk, repeats=1),
+            lambda: measure(family, accum, "on", chunk, repeats=1),
+            trials=trials,
+        )
         emit(off)
         emit(on)
-        median = float(np.median(ratios))
-        faster = "on" if median >= 1.0 else "off"
+        faster = "on" if float(np.median(ratios)) >= 1.0 else "off"
         gate[f"{family}_accum{accum}"] = faster
-        emit({
-            "metric": f"overlap_speedup_{family}_accum{accum}",
-            "value": round(median, 3),
-            "unit": "x (on/off, median of interleaved trials)",
-            "per_trial_ratios": ratios,
-            "noise_bound": bool(max(ratios) >= 1.0 >= min(ratios)),
-            "faster_path": faster,
-            "platform": on["platform"],
-        })
+        emit(speedup_record(
+            f"overlap_speedup_{family}_accum{accum}", ratios, "on/off",
+            faster_path=faster, platform=on["platform"],
+        ))
     # the measured gate BaguaTrainer's overlap="auto" encodes: overlap at
     # accum>1 for families that measured on-par-or-faster across repeated
     # runs, serialized where it lost (Algorithm.overlap_auto=False: zero,

@@ -205,10 +205,12 @@ def main():
         )[0])
         correct = total = 0
         eb = min(batch, len(eval_idx))
+        # leaf view (flat-resident state holds bucket flats)
+        eval_params = trainer.unstack_params(state)
         for i0 in range(0, len(eval_idx), eb):
             sel = eval_idx[i0:i0 + eb]  # tail partial batch included
             samples = [dataset[i] for i in sel]
-            logits = apply(state.params,
+            logits = apply(eval_params,
                            jnp.asarray(np.stack([s[0] for s in samples])))
             pred = np.argmax(np.asarray(logits), -1)
             labels = np.array([s[1] for s in samples])

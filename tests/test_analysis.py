@@ -186,6 +186,74 @@ def test_torch_import_positive():
     assert found.count("torch-import") == 2
 
 
+def test_per_step_reflatten_positive_transform_fn():
+    # the PRE-FIX contrib/fused_optimizer.update_fn pattern: per-dtype
+    # concat of tree leaves inside an optax GradientTransformation (which
+    # traces inside the jitted step by construction)
+    found = rules_of("""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        def fuse(inner):
+            def update_fn(updates, state, params=None):
+                leaves = jax.tree_util.tree_leaves(updates)
+                flat = jnp.concatenate([jnp.ravel(l) for l in leaves])
+                out, st = inner.update(flat, state, None)
+                return out, st
+            return optax.GradientTransformation(inner.init, update_fn)
+    """)
+    assert found.count("per-step-reflatten") == 1
+
+
+def test_per_step_reflatten_positive_traced_step():
+    found = rules_of("""
+        import jax
+        import jax.numpy as jnp
+
+        def step(params, batch):
+            leaves = jax.tree_util.tree_leaves(params)
+            flat = jnp.concatenate([jnp.ravel(l) for l in leaves])
+            return flat.sum()
+
+        fn = jax.jit(step)
+    """)
+    assert found.count("per-step-reflatten") == 1
+
+
+def test_per_step_reflatten_negative():
+    # flatten without concat, concat without flatten, and an untraced
+    # standalone helper are all idiom, not per-step repacking; the
+    # flat-RESIDENT step consumes pre-flattened buffers and never flattens
+    found = rules_of("""
+        import jax
+        import jax.numpy as jnp
+
+        def helper(tree):
+            return jnp.concatenate(
+                [jnp.ravel(l) for l in jax.tree_util.tree_leaves(tree)]
+            )
+
+        def resident_step(flats, batch):
+            return sum(f.sum() for f in flats)
+
+        def flatten_only(params, batch):
+            return sum(l.sum() for l in jax.tree_util.tree_leaves(params))
+
+        f1 = jax.jit(resident_step)
+        f2 = jax.jit(flatten_only)
+    """)
+    assert "per-step-reflatten" not in found
+
+
+def test_per_step_reflatten_repo_is_clean():
+    """The resident path (and the fixed fused optimizer) must lint clean."""
+    findings = run_ast_rules([PKG], rel_to=REPO)
+    assert not [f for f in findings if f.rule == "per-step-reflatten"], [
+        (f.path, f.line) for f in findings if f.rule == "per-step-reflatten"
+    ]
+
+
 # ---- suppressions ---------------------------------------------------------
 
 

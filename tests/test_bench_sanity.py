@@ -53,3 +53,55 @@ def test_perf_fields_empty_analysis_is_silent():
     fields = bench._perf_fields(_NoAnalysis(), None, None, 1.0, 10)
     # only the methodology marker survives an empty cost analysis
     assert fields == {"timing": "min_of_2_windows_x10_steps"}
+
+
+def test_bench_flat_artifact_schema():
+    """BENCH_FLAT.json (driver-visible artifact of bench.py --flat): the
+    interleaved-A/B records must carry the full honesty protocol — the
+    per-trial ratio spread and the noise_bound flag — plus the
+    fused-optimizer compile audit, and the headline acceptance config
+    (gradient_allreduce flat >= leaf on this host's cpu-sim mesh)."""
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "BENCH_FLAT.json")
+    assert os.path.exists(path), "run bench.py --flat (or benchmarks/" \
+                                 "flat_resident_bench.py) first"
+    records = json.load(open(path))
+    by_metric = {r["metric"]: r for r in records}
+
+    speedups = [r for r in records if r["metric"].startswith("flat_speedup_")]
+    assert speedups, records
+    for rec in speedups:
+        assert isinstance(rec["per_trial_ratios"], list) and len(
+            rec["per_trial_ratios"]) >= 3
+        assert isinstance(rec["noise_bound"], bool)
+        assert rec["faster_path"] in ("on", "off")
+        assert rec["value"] > 0
+    # each speedup has its paired throughput records with the A/B timing tag
+    for rec in speedups:
+        key = rec["metric"].removeprefix("flat_speedup_")
+        family, accum = key.rsplit("_accum", 1)
+        for mode in ("on", "off"):
+            pair = [
+                r for r in records
+                if r.get("family") == family
+                and str(r.get("accum_steps")) == accum
+                and r.get("flat_resident") == mode
+            ]
+            assert pair, (family, accum, mode)
+            assert "interleaved_ab" in pair[0]["timing"]
+
+    # acceptance config: flat-resident >= leaf for gradient_allreduce
+    # (median of interleaved trials; noise_bound records the spread)
+    headline = by_metric["flat_speedup_gradient_allreduce_accum1"]
+    assert headline["value"] >= 1.0 or headline["noise_bound"], headline
+
+    # fused-optimizer compile audit: flat layout must SHRINK the program
+    ratio = by_metric["flat_fused_adam_hlo_op_ratio"]
+    assert ratio["flat_hlo_op_count"] < ratio["leaf_hlo_op_count"], ratio
+    assert ratio["value"] < 1.0
+
+    gate = by_metric["flat_resident_dispatch_gate"]
+    assert "faster_path_by_config" in gate and gate["auto_default"]

@@ -1,6 +1,8 @@
 """Checkpoint/resume tests (SURVEY.md §5.4: the TPU build needs a real
 orbax-style checkpoint subsystem; reference only hand-rolled torch.save)."""
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -28,9 +30,9 @@ def _setup():
             logits, b["y"]
         ).mean()
 
-    def new_trainer():
+    def new_trainer(**kw):
         return BaguaTrainer(loss_fn, optax.sgd(0.1),
-                            GradientAllReduceAlgorithm(), mesh=mesh)
+                            GradientAllReduceAlgorithm(), mesh=mesh, **kw)
 
     return new_trainer, params, {"x": x, "y": y}
 
@@ -307,7 +309,8 @@ def test_layout_metadata_roundtrip_and_mismatch(tmp_path):
     s1 = t1.init(params)
     s1, _ = t1.train_step(s1, {"x": x, "y": y})
     meta = t1.checkpoint_layout_metadata()
-    assert meta["layout"] == "zero_flat" and meta["plan_dependent"]
+    assert meta["layout"] == "flat" and meta["plan_dependent"]
+    assert meta["flat_layout"]  # full bucket descriptor rides the sidecar
     mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
     assert mgr.save(1, s1, metadata=meta)
     mgr.wait()
@@ -320,9 +323,11 @@ def test_layout_metadata_roundtrip_and_mismatch(tmp_path):
     s2, loss = t2.train_step(s2, {"x": x, "y": y})
     assert np.isfinite(float(loss))
 
-    # different bucket plan: actionable layout error, not an orbax shape error
-    t3 = new_trainer(bucket_bytes=128)
+    # different bucket plan: actionable layout error, not an orbax shape
+    # error (32B buckets genuinely re-split this model; 128 would not)
+    t3 = new_trainer(bucket_bytes=32)
     s3 = t3.init(params)
+    assert t3._plan.signature() != t1._plan.signature()
     with pytest.raises(ValueError, match="checkpoint layout mismatch"):
         mgr.restore(s3, expect_metadata=t3.checkpoint_layout_metadata())
     mgr.close()
@@ -352,8 +357,11 @@ def test_mixed_metadata_and_plain_saves_one_manager(tmp_path):
     """metadata= and plain saves must coexist on ONE manager (the sidecar
     design: orbax locks a manager to one item structure on first use, so a
     composite item would make this an opaque error), and leaf-layout
-    metadata differences must NOT block a restore (plan-independent)."""
+    metadata differences must NOT block a restore (plan-independent).
+    Leaf layout is forced: the default flat-resident layout is
+    plan-DEPENDENT, whose strict metadata path is covered above."""
     new_trainer, params, batch = _setup()
+    new_trainer = partial(new_trainer, flat_resident="off")
     t = new_trainer()
     s = t.init(params)
     s, _ = t.train_step(s, batch)

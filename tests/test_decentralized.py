@@ -58,7 +58,11 @@ def test_matches_per_rank_golden(mode):
     batches = _batches(steps)
 
     algo = DecentralizedAlgorithm(hierarchical=False, peer_selection_mode=mode)
-    trainer = BaguaTrainer(loss_fn, optax.sgd(LR), algo, bucket_bytes=10 ** 9)
+    # leaf layout: this golden reads PER-RANK leaf weights straight off the
+    # stacked state; flat-vs-leaf step equality is pinned in
+    # tests/test_flat_resident.py
+    trainer = BaguaTrainer(loss_fn, optax.sgd(LR), algo, bucket_bytes=10 ** 9,
+                           flat_resident="off")
     st = trainer.init(params)
     for b in batches:
         st, _ = trainer.train_step(st, {"x": jnp.asarray(b["x"]), "y": jnp.asarray(b["y"])})

@@ -92,12 +92,15 @@ def test_eval_with_accum_microbatches_and_odd_batches():
     e_scanned = float(trainer.eval_step(state, trainer.shard_batch(batch2)))
 
     # microbatch-mean must equal the full-batch mean: evaluate the SAME
-    # state/batch through a no-accum trainer
+    # weights/batch through a no-accum trainer.  The leaf-layout trainer
+    # takes the weights via unstack_params — flat-resident raw state is
+    # laid out under the (readiness-re-bucketed) owning trainer's plan.
     plain = BaguaTrainer(_loss_fn(), optax.sgd(0.1),
                          GradientAllReduceAlgorithm(), bucket_bytes=256,
-                         donate=False)
+                         donate=False, flat_resident="off")
     plain.init(params)
-    e_direct = float(plain.eval_step(state, trainer.shard_batch(batch2)))
+    leaf_state = state._replace(params=trainer.unstack_params(state))
+    e_direct = float(plain.eval_step(leaf_state, trainer.shard_batch(batch2)))
     np.testing.assert_allclose(e_scanned, e_direct, rtol=1e-6)
 
     # odd batch: 8 rows (shardable by 8, not divisible by accum 4 per shard)

@@ -68,7 +68,8 @@ def test_matches_single_worker_sgd(hierarchical):
     for s in range(xs.shape[0]):
         gp, gopt = g_step(gp, gopt, {"x": xs[s], "y": ys[s]})
 
-    flat_a = jax.tree.leaves(state.params)
+    # leaf view: flat-resident raw state holds bucket flats, not leaves
+    flat_a = jax.tree.leaves(trainer.unstack_params(state))
     flat_b = jax.tree.leaves(gp)
     for a, b in zip(flat_a, flat_b):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
@@ -157,7 +158,7 @@ def test_sum_vs_avg_scales_update():
         )
         st = trainer.init(params)
         st, _ = trainer.train_step(st, batch)
-        outs[avg] = st.params
+        outs[avg] = trainer.unstack_params(st)
 
     # delta with SUM should be N times delta with AVG
     d_avg = jax.tree.map(lambda a, b: np.asarray(a - b), outs[True], params)

@@ -209,13 +209,15 @@ def _train(algo_factory, optimizer, accum, overlap, chunk=0, steps=4):
     ids=["gradient_allreduce", "zero", "bytegrad"],
 )
 def test_overlap_matches_serialized(algo_factory, optimizer, exact, accum):
-    l_off, st_off, _ = _train(algo_factory, optimizer, accum, "off")
+    l_off, st_off, tr_off = _train(algo_factory, optimizer, accum, "off")
     l_on, st_on, tr_on = _train(algo_factory, optimizer, accum, "on")
     assert tr_on._overlap_active()
     if exact:
         np.testing.assert_array_equal(l_on, l_off)
-        for a, b in zip(jax.tree.leaves(st_on.params),
-                        jax.tree.leaves(st_off.params)):
+        # leaf views: the overlap trainer re-buckets by readiness, so its
+        # flat-RESIDENT raw state is laid out under a different plan
+        for a, b in zip(jax.tree.leaves(tr_on.unstack_params(st_on)),
+                        jax.tree.leaves(tr_off.unstack_params(st_off))):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     else:
         np.testing.assert_allclose(l_on, l_off, rtol=0.05, atol=0.02)

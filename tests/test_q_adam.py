@@ -75,7 +75,9 @@ def test_warmup_matches_reference_adam_math():
         grads = grad_fn(gp, b)
         gp, m, v = _golden_qadam_step(gp, grads, m, v, i + 1)
 
-    for a, b_ in zip(jax.tree.leaves(st.params), jax.tree.leaves(gp)):
+    # leaf view: flat-resident raw state holds bucket flats, not leaves
+    for a, b_ in zip(jax.tree.leaves(trainer.unstack_params(st)),
+                     jax.tree.leaves(gp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-5)
 
 
@@ -134,7 +136,8 @@ def test_compressed_phase_tracks_uncompressed_on_identical_shards():
     # quantization noise — bound the bulk tightly and the tail loosely
     diffs = np.concatenate([
         np.abs(np.asarray(a) - np.asarray(b_)).ravel()
-        for a, b_ in zip(jax.tree.leaves(st.params), jax.tree.leaves(gp))
+        for a, b_ in zip(jax.tree.leaves(trainer.unstack_params(st)),
+                         jax.tree.leaves(gp))
     ])
     assert np.percentile(diffs, 95) < 3e-2, np.percentile(diffs, 95)
     assert diffs.max() < 0.2, diffs.max()

@@ -68,7 +68,7 @@ def test_matches_replicated_adam():
 
     # flat-resident layout: leaf views materialize via unstack_params
     z_leaves = jax.tree.leaves(zero.unstack_params(st_zero))
-    for a, b in zip(z_leaves, jax.tree.leaves(st_plain.params)):
+    for a, b in zip(z_leaves, jax.tree.leaves(plain.unstack_params(st_plain))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
 
 
@@ -370,7 +370,7 @@ def test_hierarchical_matches_flat_and_replicated():
 
     s_leaves = jax.tree.leaves(staged.unstack_params(st_staged))
     f_leaves = jax.tree.leaves(flat.unstack_params(st_flat))
-    p_leaves = jax.tree.leaves(st_plain.params)
+    p_leaves = jax.tree.leaves(plain.unstack_params(st_plain))
     for a, b, c in zip(s_leaves, f_leaves, p_leaves):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=2e-6)
