@@ -181,6 +181,16 @@ class Algorithm:
     #: this family (explicit ``flat_resident="on"`` always wins) — the
     #: measured-record gate, like :attr:`overlap_auto` (BENCH_FLAT.json).
     flat_resident_auto: bool = True
+    #: Gradient-health sentinel contract: True when the family's POST-comm
+    #: gradient representation is bitwise-identical on every rank (a plain
+    #: summed/averaged bucket reduce), so the per-bucket ``isfinite``
+    #: verdict computed on it is already globally consistent — the guard
+    #: then piggybacks on the existing bucket collectives with no extra
+    #: launch (non-finite contributions survive the sum).  Families whose
+    #: gradients stay rank-local or sharded after comm (gossip exchanges,
+    #: ZeRO chunks, QAdam's compressed-momentum pipeline) keep False and
+    #: the trainer fuses their local verdicts with one tiny ``pmin``.
+    grad_health_replicated: bool = False
 
     def need_reset(self, step: int) -> bool:
         """Host-side: return True to rebuild buckets/recompile (reference

@@ -23,6 +23,10 @@ class GradientAllReduceAlgorithm(Algorithm):
     #: (zero repacking) — measured on-par-to-faster than the leaf layout
     #: on the cpu-sim mesh (BENCH_FLAT.json), so ``auto`` takes it
     supports_flat_resident = True
+    #: reduced buckets are replicated (plain psum/ring sum — a NaN/Inf
+    #: contribution from any rank survives into every rank's copy), so the
+    #: gradient-health sentinel rides them with no extra collective
+    grad_health_replicated = True
 
     def __init__(
         self,

@@ -26,6 +26,46 @@ logger = logging.getLogger(__name__)
 _LIVE_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
 
 
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint failed content verification at restore (recorded sha256
+    digest vs the restored leaves) — torn write, bit rot, or an injected
+    ``ckpt.write`` fault.  :meth:`BaguaCheckpointManager.restore` treats it
+    (like an unreadable checkpoint) as a fallback trigger when no explicit
+    step was requested."""
+
+
+def compute_state_digest(state: Any) -> Optional[dict]:
+    """Content checksum of a state pytree: sha256 over every leaf's path,
+    shape, dtype, and raw bytes, in tree-flatten order.  Sharding- and
+    layout-agnostic w.r.t. the MESH (global logical values are hashed), so
+    an elastic restore at a different topology verifies against the digest
+    recorded at save time.  Returns None when the state cannot be fetched
+    whole (multi-process non-addressable arrays) — verification is then
+    skipped with a log line rather than hashing a partial view."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in flat:
+        h.update(jax.tree_util.keystr(path).encode())
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            if getattr(leaf, "is_fully_addressable", True) is False:
+                logger.info(
+                    "checkpoint integrity: %s is not fully addressable on "
+                    "this process; digest skipped", jax.tree_util.keystr(path),
+                )
+                return None
+            arr = np.asarray(leaf)
+            h.update(str(arr.shape).encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+        else:
+            h.update(repr(leaf).encode())
+    return {"algo": "sha256", "digest": h.hexdigest(), "leaves": len(flat)}
+
+
 def flush_all_checkpoints(timeout_s: float = 10.0) -> None:
     """Best-effort flush of every live manager's queued async saves, bounded
     by ``timeout_s`` — called by the watchdog before it terminates a wedged
@@ -65,7 +105,14 @@ class BaguaCheckpointManager:
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
         async_save: bool = True,
+        integrity: bool = True,
     ):
+        """``integrity=True`` (default) records a content checksum
+        (:func:`compute_state_digest`) in every save's layout sidecar and
+        verifies it on restore — a corrupted/torn checkpoint then degrades
+        to the previous verified step (loud warning) instead of restoring
+        garbage.  Costs one host readback of the state per save; set False
+        to opt out (e.g. states too large to fetch per save)."""
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -77,11 +124,15 @@ class BaguaCheckpointManager:
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
         self._async_save = bool(async_save)
+        self._integrity = bool(integrity)
         # layout sidecars whose orbax save is not yet known-durable:
         # written only once the async save finishes (wait()/close()/next
         # save), so a crash mid-save can't leave a sidecar pointing at a
         # checkpoint that never became readable (ADVICE.md)
         self._pending_layouts: dict = {}
+        # steps whose durable files the chaos ``ckpt.write`` hook has not
+        # yet had a chance to corrupt (same durability gating as sidecars)
+        self._uncorrupted_steps: list = []
         _LIVE_MANAGERS.add(self)
 
     def save(self, step: int, state: Any, metadata: Optional[dict] = None) -> bool:
@@ -115,24 +166,58 @@ class BaguaCheckpointManager:
             # checkpoints — flushing on a skipped save would reopen the
             # crash window this deferral exists to close
             self._flush_pending_layouts()
-        if saved and metadata is not None:
-            if self._async_save:
-                # stashed on EVERY process (written by process 0 only):
-                # a restore of a not-yet-flushed step must see the same
-                # metadata on all processes, or a layout mismatch would
-                # raise on process 0 alone and strand the others in the
-                # collective orbax restore
-                self._pending_layouts[int(step)] = metadata
-            else:
-                self._write_layout(int(step), metadata)
+            self._run_chaos_corruption()
+        if saved:
+            # integrity chain: the content digest rides the layout sidecar
+            # (computed here, while the state is still live — donation in
+            # the next train step may invalidate these buffers)
+            meta = dict(metadata) if metadata is not None else {}
+            if self._integrity and "integrity" not in meta:
+                digest = compute_state_digest(state)
+                if digest is not None:
+                    meta["integrity"] = digest
+            if meta:
+                if self._async_save:
+                    # stashed on EVERY process (written by process 0 only):
+                    # a restore of a not-yet-flushed step must see the same
+                    # metadata on all processes, or a layout mismatch would
+                    # raise on process 0 alone and strand the others in the
+                    # collective orbax restore
+                    self._pending_layouts[int(step)] = meta
+                else:
+                    self._write_layout(int(step), meta)
+            self._uncorrupted_steps.append(int(step))
+            if not self._async_save:
+                self._run_chaos_corruption()
         return saved
+
+    def _run_chaos_corruption(self) -> None:
+        """Apply any armed ``ckpt.write`` fault to steps whose orbax files
+        are now durable (cheap no-op while nothing is armed).  Gated like
+        the sidecar flush: corrupting a still-in-flight async save would
+        race the writer instead of modeling post-publish rot."""
+        from .faults import inject as _inject
+
+        pending, self._uncorrupted_steps = self._uncorrupted_steps, []
+        for step in pending:
+            _inject.maybe_corrupt_checkpoint(self.directory, step)
 
     def _write_layout(self, step: int, metadata: dict) -> None:
         import json
 
+        from .faults import inject as _inject
+
         if jax.process_index() != 0:
             return
-        self._layout_path(step).write_text(json.dumps(metadata))
+        path = self._layout_path(step)
+        # atomic publish (tmp + replace, the native_build.py:71 pattern): a
+        # crash mid-write must leave either no sidecar or a complete one —
+        # a torn sidecar would fail JSON parsing and discard the layout AND
+        # integrity record of a perfectly good checkpoint
+        tmp = path.parent / f".{path.name}.tmp"
+        tmp.write_text(json.dumps(metadata))
+        tmp.replace(path)
+        _inject.maybe_corrupt_sidecar(path, step)  # chaos: ckpt.sidecar
         self._prune_layout_sidecars()
 
     def _flush_pending_layouts(self) -> None:
@@ -181,7 +266,16 @@ class BaguaCheckpointManager:
         path = self._layout_path(step)
         if not path.exists():
             return None
-        return json.loads(path.read_text())
+        try:
+            return json.loads(path.read_text())
+        except (ValueError, UnicodeDecodeError) as e:
+            # a torn/garbage sidecar makes the step unverifiable — surface
+            # it as an integrity failure so a latest-step restore degrades
+            # to the previous verified checkpoint instead of crashing here
+            raise CheckpointIntegrityError(
+                f"layout sidecar for step {step} is unreadable ({e}) — "
+                "torn write or corruption"
+            ) from e
 
     def read_layout(self, step: int) -> Optional[dict]:
         """The layout sidecar saved with ``step`` (None when the step was
@@ -191,8 +285,9 @@ class BaguaCheckpointManager:
         return self._read_layout(int(step))
 
     #: metadata keys that carry layout PAYLOAD (the full bucket layout
-    #: descriptor), not compatibility constraints — never compared
-    _LAYOUT_PAYLOAD_KEYS = ("flat_layout", "stacked")
+    #: descriptor) or side-channel records (the integrity digest), not
+    #: compatibility constraints — never compared
+    _LAYOUT_PAYLOAD_KEYS = ("flat_layout", "stacked", "integrity")
 
     @classmethod
     def _normalize_layout(cls, meta: Optional[dict]) -> Optional[dict]:
@@ -321,13 +416,78 @@ class BaguaCheckpointManager:
         ELASTIC restart, where orbax's fallback of reading shardings from
         the checkpoint file would silently resurrect the OLD topology),
         falling back to the mesh harvested from sibling leaves, then to the
-        global mesh.
-        """
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        global mesh.  Elastic restores verify the integrity digest too —
+        the digest hashes global logical values, so it is topology-free.
 
+        Integrity chain: with no explicit ``step``, restore walks steps
+        NEWEST-FIRST and lands on the first one that verifies — an
+        unreadable checkpoint, a torn/garbage sidecar, or a content-digest
+        mismatch each disqualify a step with a loud warning and fall back
+        to the previous one.  An EXPLICIT ``step`` never falls back: a
+        verification failure raises :class:`CheckpointIntegrityError`.
+        Layout mismatches (``expect_metadata``) are configuration errors,
+        not corruption — they raise immediately in both modes.
+        """
+        if step is not None:
+            return self._restore_step(
+                int(step), state_like, expect_metadata, mesh
+            )
+        return self._restore_newest_verified(
+            lambda s: self._restore_step(s, state_like, expect_metadata,
+                                         mesh)
+        )
+
+    def _restore_newest_verified(self, restore_one):
+        """The ONE integrity-fallback policy: walk steps newest-first and
+        return the first result ``restore_one(step)`` produces without a
+        :class:`CheckpointIntegrityError` — also used by
+        ``BaguaTrainer.restore_checkpoint`` so the trainer's layout-aware
+        restore cannot drift from the manager's."""
+        from .faults import inject as _inject
+
+        candidates = sorted(
+            (int(s) for s in self._mgr.all_steps()), reverse=True
+        )
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        last_err: Optional[Exception] = None
+        for i, s in enumerate(candidates):
+            try:
+                result = restore_one(s)
+            except CheckpointIntegrityError as e:
+                from .telemetry import counters
+
+                counters.incr("ckpt/integrity_failures")
+                logger.error(
+                    "checkpoint step %d FAILED verification (%s) — falling "
+                    "back to the previous checkpoint", s, e,
+                )
+                last_err = e
+                continue
+            if i > 0:
+                from .telemetry import counters
+
+                counters.incr("ckpt/fallback_restores")
+                logger.warning(
+                    "checkpoint integrity: restored step %d after %d newer "
+                    "checkpoint(s) failed verification — training resumes "
+                    "from an OLDER state than the last save", s, i,
+                )
+                _inject.record_recovery("ckpt.write")
+                _inject.record_recovery("ckpt.sidecar")
+            return result
+        raise CheckpointIntegrityError(
+            f"no checkpoint under {self.directory} passed verification "
+            f"({len(candidates)} candidate step(s) tried)"
+        ) from last_err
+
+    def _restore_step(
+        self,
+        step: int,
+        state_like: Any,
+        expect_metadata: Optional[dict],
+        mesh: Optional[Any],
+    ) -> Tuple[int, Any]:
         from jax.sharding import NamedSharding, PartitionSpec
 
         if mesh is None:
@@ -354,12 +514,54 @@ class BaguaCheckpointManager:
 
         abstract = jax.tree.map(abstract_leaf, state_like)
         # validate the layout sidecar FIRST: the actionable mismatch error
-        # must fire before orbax hits an opaque flat-shape mismatch
-        self._check_layout(self._read_layout(step), expect_metadata)
-        restored = self._mgr.restore(
-            int(step), args=self._ocp.args.StandardRestore(abstract)
-        )
+        # must fire before orbax hits an opaque flat-shape mismatch.  A
+        # corrupted sidecar raises CheckpointIntegrityError from the read
+        # itself (fallback trigger); a layout MISMATCH is a configuration
+        # error and propagates as ValueError (never a fallback)
+        sidecar = self._read_layout(step)
+        self._check_layout(sidecar, expect_metadata)
+        try:
+            restored = self._mgr.restore(
+                int(step), args=self._ocp.args.StandardRestore(abstract)
+            )
+        except Exception as e:
+            # orbax could not materialize the step (missing/truncated/
+            # garbage files): corruption class, not configuration.
+            # Deliberate tradeoff: a transient fs error or a stale
+            # state_like structure is reclassified too — the walk then
+            # tries older steps and the terminal error chains this one, so
+            # the root cause stays visible; distinguishing "transient" from
+            # "corrupt" generically across orbax/tensorstore backends is
+            # not feasible here
+            raise CheckpointIntegrityError(
+                f"checkpoint step {step} is unreadable "
+                f"({type(e).__name__}: {e})"
+            ) from e
+        self._verify_integrity(step, sidecar, restored)
         return int(step), restored
+
+    def _verify_integrity(self, step: int, sidecar: Optional[dict],
+                          restored: Any) -> None:
+        """Compare the restored state's content digest against the one
+        recorded at save time (no-op for checkpoints saved without one, or
+        when the manager opted out of integrity)."""
+        recorded = (sidecar or {}).get("integrity")
+        if not self._integrity or not recorded:
+            return
+        actual = compute_state_digest(restored)
+        if actual is None:  # multi-process partial view: cannot verify
+            logger.info("checkpoint integrity: step %d not verifiable on "
+                        "this process (non-addressable state)", step)
+            return
+        if actual["digest"] != recorded.get("digest"):
+            raise CheckpointIntegrityError(
+                f"checkpoint step {step} content digest mismatch "
+                f"(saved {recorded.get('digest', '?')[:12]}…, restored "
+                f"{actual['digest'][:12]}…) — on-disk corruption"
+            )
+        from .telemetry import counters
+
+        counters.incr("ckpt/verified_restores")
 
     def try_restore(
         self,
@@ -380,8 +582,10 @@ class BaguaCheckpointManager:
         deferred layout sidecars."""
         self._mgr.wait_until_finished()
         self._flush_pending_layouts()
+        self._run_chaos_corruption()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._flush_pending_layouts()
+        self._run_chaos_corruption()
         self._mgr.close()

@@ -83,6 +83,9 @@ def abort(reason: str = "user abort") -> None:
     global _ABORT_REASON
     _ABORT_REASON = reason
     _ABORT_EVENT.set()
+    from .telemetry import counters
+
+    counters.incr("comm/aborts")
     logger.error("bagua_tpu: communication aborted: %s", reason)
 
 
@@ -101,8 +104,17 @@ def reset_abort() -> None:
     """Clear the abort flag (recovery path after the cause was handled —
     the reference re-creates communicators after an abort)."""
     global _ABORT_REASON
+    was_aborted = _ABORT_EVENT.is_set()
     _ABORT_REASON = None
     _ABORT_EVENT.clear()
+    if was_aborted:
+        from .faults import inject as _inject
+        from .telemetry import counters
+
+        counters.incr("comm/abort_resets")
+        # an injected collective hang that reached abort and was then
+        # reset is a completed recovery (chaos-drill accounting)
+        _inject.record_recovery("collective.hang")
 
 
 def collapse_trivial_axes(mesh: Mesh, axes) -> Tuple[str, ...]:

@@ -169,6 +169,8 @@ class BaguaTrainer:
         overlap: Optional[str] = None,
         overlap_chunk_bytes: Optional[int] = None,
         flat_resident: Optional[str] = None,
+        grad_guard: Optional[str] = None,
+        grad_guard_budget: int = 3,
     ):
         """``expert_axis``: mesh axis carrying expert parallelism (MoE).
         Expert params are sharded over it and excluded from the data-parallel
@@ -264,7 +266,25 @@ class BaguaTrainer:
         update for element i may only read element i); shape-aware
         transforms (factored second moments) change meaning on flats —
         use ``flat_resident="off"`` for those.  Leaf pytrees for
-        eval/checkpoint/user code come from ``unstack_params(state)``."""
+        eval/checkpoint/user code come from ``unstack_params(state)``.
+
+        ``grad_guard``: the gradient-health sentinel (docs/robustness.md).
+        Every step computes a per-bucket ``isfinite`` verdict on the
+        gradients — riding the already-reduced bucket buffers where the
+        family replicates them (no extra collective), else one fused
+        MIN-allreduce of the per-bucket scalars — surfaced as
+        ``trainer.step_metrics["grad_healthy"]``.  Policy ``"off"``
+        (default, or env ``BAGUA_GRAD_GUARD``) adds nothing to the traced
+        program; ``"warn"`` logs unhealthy steps; ``"skip"`` REWINDS them
+        (params/opt/algo state keep their pre-step values — exact in flat
+        and leaf layouts and under ``accum_steps > 1``, since the verdict
+        is computed on the fully-accumulated gradient) and escalates to
+        abort after ``grad_guard_budget`` consecutive skips; ``"abort"``
+        raises the comm abort flag on the first unhealthy step.  The
+        verdict is identical on every rank, so replicated state never
+        diverges.  With the guard on and healthy gradients the selects
+        pass the new state through bitwise — loss trajectories are
+        byte-identical to ``"off"``."""
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.algorithm = algorithm
@@ -376,6 +396,23 @@ class BaguaTrainer:
                 "live outside the bucket plan; use flat_resident='auto' "
                 "or 'off'"
             )
+        self.grad_guard = (grad_guard or env.get_grad_guard_mode()).strip().lower()
+        if self.grad_guard not in ("off", "warn", "skip", "abort"):
+            raise ValueError(
+                f"grad_guard must be off|warn|skip|abort, got {grad_guard!r}"
+            )
+        if grad_guard_budget < 1:
+            raise ValueError(
+                f"grad_guard_budget must be >= 1, got {grad_guard_budget}"
+            )
+        self.grad_guard_budget = int(grad_guard_budget)
+        self._guard_skips = 0
+        self._pending_health: list = []
+        #: per-step observability surface (host side): after each
+        #: ``train_step`` under an active grad guard, ``grad_healthy`` is
+        #: the step's scalar verdict and ``grad_health_buckets`` the
+        #: per-bucket vector (async jax arrays — reading them syncs)
+        self.step_metrics: Dict[str, Any] = {}
         self._overlap_ordered = False
         self.bucket_bytes = bucket_bytes or env.get_default_bucket_size()
         self.model_name = model_name
@@ -985,14 +1022,104 @@ class BaguaTrainer:
         )(params)
         return TrainState(jnp.zeros((), jnp.int32), p_stacked, opt_state, algo_state)
 
+    # ---- gradient-health sentinel (traced helpers) -----------------------
+
+    def _grad_health_vec(self, plan: BucketPlan, grads):
+        """Per-bucket finiteness of ``grads`` as a float32 vector (traced):
+        1.0 = every element of the bucket is finite.  Leaves outside the
+        bucket plan (model-parallel/expert slices, flat-layout ``local``
+        entries) share one trailing slot.  Works on both gradient layouts
+        — the ``{"flats", "local"}`` container checks its resident buffers
+        directly (zero repacking)."""
+        extras = []
+        if self._is_flat_container(grads):
+            flags = [jnp.isfinite(f).all() for f in grads["flats"]]
+            extras = [jnp.isfinite(v).all()
+                      for v in jax.tree.leaves(grads["local"])]
+        else:
+            bucket_of = {t.name: i for i, b in enumerate(plan.buckets)
+                         for t in b.tensors}
+            per = [[] for _ in plan.buckets]
+            for path, leaf in jax.tree_util.tree_flatten_with_path(grads)[0]:
+                flag = jnp.isfinite(leaf).all()
+                i = bucket_of.get(_name_of_path(path))
+                (per[i] if i is not None else extras).append(flag)
+            flags = [jnp.stack(fl).all() if fl else jnp.bool_(True)
+                     for fl in per]
+        if extras:
+            flags.append(jnp.stack(extras).all())
+        if not flags:  # nothing to check (empty plan, no leaves)
+            return jnp.ones((1,), jnp.float32)
+        return jnp.stack(flags).astype(jnp.float32)
+
+    def _apply_grad_poison(self, plan: BucketPlan, grads, step, specs):
+        """Chaos: compile armed ``grad.poison`` specs into the step — at
+        the spec's (traced) step number, the first element of the target
+        bucket's gradient becomes NaN/Inf.  Off-step the gradient passes
+        through bitwise (a full select, not ``+0.0`` — that would flip
+        ``-0.0`` gradients)."""
+        for spec in specs:
+            bad = jnp.float32(jnp.nan if spec.kind == "nan" else jnp.inf)
+            # a traced fault cannot mutate host fire-counters, so count is
+            # compiled in as a step window: step=K fires exactly at K;
+            # step=None fires on the first `count` steps (count<0: every
+            # step)
+            if spec.step is not None:
+                fire = step == jnp.int32(spec.step)
+            elif spec.count < 0:
+                fire = jnp.bool_(True)
+            else:
+                fire = step < jnp.int32(spec.count)
+            b = spec.bucket % max(1, len(plan.buckets))
+            if self._is_flat_container(grads):
+                flats = list(grads["flats"])
+                f = flats[b]
+                flats[b] = jnp.where(fire, f.at[0].set(bad.astype(f.dtype)), f)
+                grads = {"flats": tuple(flats), "local": grads["local"]}
+            else:
+                target = plan.buckets[b].tensors[0].name
+
+                def poison_leaf(path, g, _t=target, _fire=fire, _bad=bad):
+                    if _name_of_path(path) != _t:
+                        return g
+                    poisoned = g.at[(0,) * g.ndim].set(_bad.astype(g.dtype))
+                    return jnp.where(_fire, poisoned, g)
+
+                grads = jax.tree_util.tree_map_with_path(poison_leaf, grads)
+        return grads
+
     # ---- step ------------------------------------------------------------
 
     def _make_step_fn(self, plan: BucketPlan):
+        from ..faults import inject as _inject
+
         algo = self.algorithm
         overlap = self._overlap_active()
         ctx = self._ctx(plan, overlap=overlap)
         mesh = self.mesh
         dp = self.dp_axes
+        guard = self.grad_guard
+        poison_specs = _inject.armed_traced_specs("grad.poison")
+        # post-comm gradients are bitwise-identical on every rank only for
+        # dense allreduce-style families on a mesh without model-parallel
+        # axes — there the health check rides the already-reduced buffers
+        # and needs NO collective of its own (non-finite contributions
+        # propagate through the sum); everything else checks locally and
+        # combines verdicts with one fused pmin
+        replicated_health = (
+            algo.grad_health_replicated
+            and self.expert_axis is None
+            and self._shard_axis is None
+        )
+        # gossip-style families keep PER-RANK weight replicas, so the guard
+        # verdict is per-rank too: each rank rewinds its own replica (the
+        # next exchange re-syncs a skipped rank) and no health collective
+        # is added
+        local_health = not algo.replicated_params
+        mp_health = (
+            self.expert_axis is not None or self._shard_axis is not None
+        )
+        health_axes = tuple(a for a in mesh.axis_names if mesh.shape[a] > 1)
         replicated = algo.replicated_params
         expert = self.expert_axis
         # per-shard state is stacked (leading rank axis) for gossip
@@ -1086,6 +1213,13 @@ class BaguaTrainer:
                 grads = jax.tree.map(lambda g: g / accum, grads)
             else:
                 loss, grads = jax.value_and_grad(loss_on)(params, batch)
+            if poison_specs:
+                # chaos: traced NaN/Inf injection into the accumulated
+                # gradient (pre-comm, so detection sees exactly what the
+                # collectives would spread)
+                grads = self._apply_grad_poison(plan, grads, step,
+                                                poison_specs)
+            health_vec = None
             if self.pp_axis is not None and mesh.shape[self.pp_axis] > 1:
                 # replicated-leaf grads are PARTIAL per pipeline stage: the
                 # bucket allreduce spans pp, so prescaling by pp_size turns
@@ -1151,6 +1285,13 @@ class BaguaTrainer:
                     return jax.lax.pmean(g, tp_dp)
 
                 grads = jax.tree_util.tree_map_with_path(tp_grad, grads)
+            if guard != "off" and replicated_health:
+                # piggybacked health: the reduced bucket buffers are the
+                # SAME array on every rank, and a NaN/Inf contribution from
+                # any rank survives the sum — so per-bucket isfinite on
+                # them is a globally consistent verdict, no extra
+                # collective launched
+                health_vec = self._grad_health_vec(plan, grads)
             params, algo_state = algo.process_pre_step(ctx, params, algo_state, step)
             if algo.owns_optimizer:
                 params, opt_state, algo_state = algo.optimizer_update(
@@ -1160,6 +1301,23 @@ class BaguaTrainer:
                 updates, opt_state = self._opt.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
             params, algo_state = algo.process_post_step(ctx, params, algo_state, step)
+            if guard != "off" and not replicated_health:
+                # families whose post-comm gradient representation is not
+                # rank-replicated detect on the UPDATED params instead:
+                # every elementwise optimizer propagates a NaN/Inf gradient
+                # into its parameter, params are materialized outputs (so
+                # reading them cannot perturb backward fusion the way
+                # reductions over raw grad arrays measurably do), and the
+                # family's own comm makes the verdict consistent where it
+                # must be — ZeRO's allgather spreads a poisoned chunk into
+                # every rank's params, QAdam's momentum allreduce is
+                # replicated, gossip replicas are per-rank by design (each
+                # rank rewinds its own).  Model-parallel slices live only
+                # on their shard, so those meshes fuse verdicts with one
+                # tiny pmin.
+                health_vec = self._grad_health_vec(plan, params)
+                if mp_health and health_axes:
+                    health_vec = jax.lax.pmin(health_vec, health_axes)
 
             loss = ctx.comm.allreduce(loss, ReduceOp.AVG)
             if stacked:
@@ -1170,7 +1328,32 @@ class BaguaTrainer:
                 opt_state = {"buckets": _stack(opt_state["buckets"]),
                              "local": opt_state["local"]}
                 algo_state = _stack(algo_state)
-            return TrainState(state.step + 1, params, opt_state, algo_state), loss
+            new_state = TrainState(state.step + 1, params, opt_state,
+                                   algo_state)
+            if guard == "off":
+                return new_state, loss
+            if guard == "skip":
+                # rewind: an unhealthy step keeps the pre-step params/opt/
+                # algo state bitwise (the verdict is rank-uniform, so
+                # replicated state cannot diverge); the step counter still
+                # advances, so a poison armed at one step cannot re-fire
+                # forever.  keep=True selects the new values bitwise —
+                # with healthy gradients the trajectory is byte-identical
+                # to guard "off".
+                keep = jnp.min(health_vec) > 0.5
+
+                def sel(n, o):
+                    return jnp.where(keep, n, o)
+
+                new_state = TrainState(
+                    new_state.step,
+                    jax.tree.map(sel, new_state.params, state.params),
+                    jax.tree.map(sel, new_state.opt_state, state.opt_state),
+                    jax.tree.map(sel, new_state.algo_state, state.algo_state),
+                )
+            # a leading row axis: rank-uniform verdicts replicate ([1, b]),
+            # per-rank (gossip) verdicts stack over the dp axes ([ranks, b])
+            return new_state, loss, health_vec[None]
 
         if expert is not None and not algo.sharded_opt_state:
             pspec = P((expert,))
@@ -1199,11 +1382,16 @@ class BaguaTrainer:
         batch_spec = self._batch_spec()
         self._state_specs = state_specs  # reused by eval_step
 
+        health_spec = P(self.dp_axes) if local_health else P()
+        out_specs = (
+            (state_specs, P()) if guard == "off"
+            else (state_specs, P(), health_spec)
+        )
         fn = shard_map(
             per_shard,
             mesh=mesh,
             in_specs=(state_specs, batch_spec),
-            out_specs=(state_specs, P()),
+            out_specs=out_specs,
             check_vma=False,
         )
         return jax.jit(fn, donate_argnums=(0,) if self.donate else ())
@@ -1231,6 +1419,8 @@ class BaguaTrainer:
         return tree_from_named(self._param_template, named)
 
     def _get_step_fn(self):
+        from ..faults import inject as _inject
+
         overlap = self._overlap_active()
         key = (
             self._plan.signature(),
@@ -1242,6 +1432,14 @@ class BaguaTrainer:
             # active (_ctx nulls them otherwise) — keying the raw value
             # would recompile bit-identical serialized steps
             self.overlap_chunk_bytes if overlap else 0,
+            # grad guard: "warn" and "abort" trace the same program (the
+            # policy difference is host-side), "skip" adds the rewind
+            # selects; armed traced faults compile into the step, so their
+            # signatures key it too
+            ("skip" if self.grad_guard == "skip" else "observe")
+            if self.grad_guard != "off" else "off",
+            tuple(s.signature()
+                  for s in _inject.armed_traced_specs("grad.poison")),
             # compile_key stays LAST: introspection (tests, debugging)
             # reads it as key[-1]
             self.algorithm.compile_key(),
@@ -1304,7 +1502,20 @@ class BaguaTrainer:
             state = self._pending_state_migration(state)
             self._pending_state_migration = None
         fn = self._get_step_fn()
+        # poison accounting reads the persisted state.step BEFORE dispatch:
+        # the buffers are donated to fn, and the compiled fault fires on
+        # state.step (which resumes from checkpoints), not the
+        # trainer-local call counter
+        self._note_traced_fault_fires(state)
         out = fn(state, batch)
+        if self.grad_guard != "off":
+            new_state, loss, health_vec = out
+            self.step_metrics = {
+                "grad_healthy": jnp.min(health_vec),
+                "grad_health_buckets": jnp.min(health_vec, axis=0),
+            }
+            self._note_step_health(health_vec)
+            out = (new_state, loss)
         if self._watchdog is not None:
             # asynchronous watching: dispatch continues at full speed while
             # the watchdog's waiter thread reads the loss back inside a
@@ -1317,6 +1528,114 @@ class BaguaTrainer:
             )
         self._auto_record_speed(batch)
         return out
+
+    # ---- gradient-health sentinel (host-side policy) ---------------------
+
+    def _note_step_health(self, health_vec) -> None:
+        """Queue this step's (async) health verdict and act on the ones
+        already complete.  The guard inspects each step's verdict when the
+        NEXT step is dispatched — by then the previous program has
+        finished, so the readback does not stall the dispatch pipeline."""
+        self._pending_health.append((self._step_counter, health_vec))
+        while len(self._pending_health) > 1:
+            self._consume_health(*self._pending_health.pop(0))
+
+    def flush_grad_health(self) -> None:
+        """Drain every not-yet-inspected step verdict (blocking readback).
+        Call at a training-loop boundary so the FINAL step's verdict is
+        acted on too — per-step inspection always runs one step behind."""
+        while self._pending_health:
+            self._consume_health(*self._pending_health.pop(0))
+
+    @staticmethod
+    def _local_value(arr):
+        """Host value of a (possibly multi-process global) array — the
+        LOCAL shard when the global cannot be fetched whole, the same
+        per-process contract as the watchdog's readback fence."""
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)
+        return np.asarray(arr.addressable_shards[0].data)
+
+    def _consume_health(self, step_no: int, health_vec) -> None:
+        from ..communication import abort
+        from ..faults import inject as _inject
+        from ..telemetry import counters
+
+        # min over verdict rows (rank-uniform verdicts replicate; per-rank
+        # gossip verdicts stack — this process acts on ALL its local rows,
+        # so multi-device processes see every local replica's verdict)
+        if getattr(health_vec, "is_fully_addressable", True):
+            hv = np.asarray(health_vec)
+        else:
+            hv = np.concatenate(
+                [np.asarray(s.data)
+                 for s in health_vec.addressable_shards], axis=0
+            )
+        hv = hv.min(axis=0)
+        if bool(hv.min() > 0.5):
+            self._guard_skips = 0
+            return
+        bad = [i for i, v in enumerate(hv) if v <= 0.5]
+        counters.incr("grad_guard/unhealthy_steps")
+        if self.grad_guard == "warn":
+            logger.warning(
+                "grad guard: step %d produced non-finite gradients "
+                "(buckets %s) — policy 'warn': the update was APPLIED and "
+                "replicated state is now poisoned; use BAGUA_GRAD_GUARD="
+                "skip to rewind such steps", step_no, bad,
+            )
+        elif self.grad_guard == "abort":
+            counters.incr("grad_guard/aborts")
+            # later queued verdicts describe steps run on the already-
+            # poisoned state: acting on them after the operator resets the
+            # abort and restores a clean checkpoint would re-trip the
+            # guard spuriously
+            self._pending_health.clear()
+            abort(
+                f"grad guard: step {step_no} produced non-finite gradients "
+                f"(buckets {bad})"
+            )
+        elif self.grad_guard == "skip":
+            self._guard_skips += 1
+            counters.incr("grad_guard/skipped_steps")
+            _inject.record_recovery("grad.poison")
+            logger.warning(
+                "grad guard: step %d produced non-finite gradients "
+                "(buckets %s) — step rewound (params/opt state untouched; "
+                "%d/%d consecutive skips)", step_no, bad,
+                self._guard_skips, self.grad_guard_budget,
+            )
+            if self._guard_skips >= self.grad_guard_budget:
+                counters.incr("grad_guard/aborts")
+                self._pending_health.clear()
+                abort(
+                    f"grad guard: {self._guard_skips} consecutive unhealthy "
+                    f"steps reached the skip budget "
+                    f"({self.grad_guard_budget}) — systematic divergence, "
+                    "not a transient bad batch"
+                )
+
+    def _note_traced_fault_fires(self, state: TrainState) -> None:
+        """Host-side telemetry for traced faults: the compiled step fires
+        ``grad.poison`` on its own; mirror the event into the counters by
+        reading the step counter the traced condition actually compares
+        against — ``state.step``, which survives checkpoint resumes where
+        the trainer-local call counter restarts at 0.  The readback only
+        happens while a poison spec is armed (drills), never in clean
+        runs."""
+        from ..faults import inject as _inject
+
+        specs = _inject.armed_traced_specs("grad.poison")
+        if not specs:
+            return
+        traced_step = int(self._local_value(state.step))
+        for spec in specs:
+            if spec.step is not None:
+                fired = spec.step == traced_step
+            else:  # the compiled step-window semantics of _apply_grad_poison
+                fired = spec.count < 0 or traced_step < spec.count
+            if fired:
+                _inject.note_traced_fire(spec)
 
     def _auto_record_speed(self, batch) -> None:
         """Feed the throughput tracker from the step itself (reference
@@ -1864,12 +2183,18 @@ class BaguaTrainer:
                 "trainer.init(params) first"
             )
         self._require_no_pending_migration("restore_checkpoint")
-        if step is None:
-            step = manager.latest_step()
-        if step is None:
-            raise FileNotFoundError(
-                f"no checkpoint under {manager.directory}"
-            )
+        if step is not None:
+            return self._restore_checkpoint_at(manager, state_like,
+                                               int(step))
+        # integrity fallback: with no explicit step, ride the manager's
+        # newest-first walk — a corrupted latest checkpoint degrades to
+        # the previous verified one instead of crashing the resume
+        return manager._restore_newest_verified(
+            lambda s: self._restore_checkpoint_at(manager, state_like, s)
+        )
+
+    def _restore_checkpoint_at(self, manager, state_like: TrainState,
+                               step: int):
         expected = self.checkpoint_layout_metadata()
         saved = manager.read_layout(step)
         # the manager owns legacy-alias normalization ("zero_flat"->"flat")

@@ -299,7 +299,10 @@ class _RestartStore:
         self._client = _connect_restart_store(args, connect_timeout_s)
 
     def _retry(self, opname, op):
+        from ..faults import inject as _inject
+
         try:
+            _inject.maybe_raise_store_error(opname)  # chaos: store.op flake
             return op(self._client)
         except _STORE_RETRY_ERRORS as e:
             logger.warning(
@@ -307,7 +310,10 @@ class _RestartStore:
                 "connection", opname, type(e).__name__, e,
             )
             self._client = _connect_restart_store(self._args, timeout_s=5.0)
-            return op(self._client)
+            result = op(self._client)
+            if isinstance(e, _inject.InjectedFault):
+                _inject.record_recovery("store.op")
+            return result
 
     def set(self, key, value):
         return self._retry(f"set({key!r})", lambda c: c.set(key, value))

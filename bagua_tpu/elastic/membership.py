@@ -46,6 +46,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .. import env as _env
+from ..faults import inject as _inject
 
 logger = logging.getLogger("bagua_tpu.elastic")
 
@@ -265,6 +266,12 @@ class LeaseHeartbeat:
                         self._epoch, fence, self._node_id,
                     )
                     return
+                if _inject.should_drop_heartbeat():
+                    # chaos: an armed ``elastic.heartbeat`` fault starves
+                    # this node's lease (the sequence number stops
+                    # advancing) without killing any process — the
+                    # coordinator must expire it and shrink the world
+                    continue
                 seq += 1
                 client.beat(self._epoch, seq)
             except (ConnectionError, OSError, TimeoutError):

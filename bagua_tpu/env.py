@@ -78,6 +78,20 @@ _declare("BAGUA_COORDINATOR_ADDR", "str", "",
 _declare("BAGUA_COMM_TIMEOUT_S", "str", "300",
          "Hang-watchdog timeout for watched collectives, in seconds; "
          "``0``/``off``/``false``/``none`` disables the watchdog.")
+# -- robustness / fault handling --
+_declare("BAGUA_GRAD_GUARD", "enum", "off",
+         "Gradient-health sentinel policy: per-bucket isfinite checks on "
+         "every step's gradients.  `warn` logs unhealthy steps, `skip` "
+         "rewinds them (params/optimizer state untouched) and escalates to "
+         "abort after a consecutive-skip budget, `abort` raises the comm "
+         "abort flag on the first unhealthy step.  See docs/robustness.md.",
+         choices=("off", "warn", "skip", "abort"))
+_declare("BAGUA_FAULT_PLAN", "str", "",
+         "Deterministic fault-injection plan (JSON list of specs: point, "
+         "kind, step/op trigger, count, seed) armed at process start — "
+         "drills and chaos tests only, never production.  Points: "
+         "store.op, elastic.heartbeat, ckpt.write, ckpt.sidecar, "
+         "collective.hang, grad.poison.  See bagua_tpu.faults.inject.")
 # -- autotune sidecar --
 _declare("BAGUA_SERVICE_PORT", "int", "-1",
          "Port of the autotune hyperparameter service; -1 disables.")
@@ -183,6 +197,31 @@ def env_bool(name: str) -> bool:
     if v is None:
         return spec.default == "1"
     return v != "0" if spec.default == "1" else v == "1"
+
+
+#: values that read as "disabled" for off-switchable duration vars
+#: (:func:`env_seconds_or_off`); the empty string counts too
+_OFF_VALUES = ("", "0", "off", "false", "no", "none")
+
+
+def env_seconds_or_off(name: str) -> Optional[float]:
+    """Float seconds with an off switch: ``0``/``off``/``false``/``no``/
+    ``none``/empty mean disabled (None).  An explicitly EMPTY value is
+    honored as off — only an unset variable falls back to the registry
+    default (the ``BAGUA_COMM_TIMEOUT_S`` contract: collapsing ``""`` to
+    the default would silently re-enable the watchdog)."""
+    v = os.environ.get(name)
+    if v is None:
+        v = ENV_REGISTRY[name].default
+    if v.strip().lower() in _OFF_VALUES:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a number of seconds or one of "
+            f"{'/'.join(repr(x) for x in _OFF_VALUES)}, got {v!r}"
+        ) from None
 
 
 def env_enum(name: str) -> str:
@@ -293,13 +332,23 @@ def get_coordinator_addr() -> Optional[str]:
     return _raw("BAGUA_COORDINATOR_ADDR")
 
 
-def get_comm_timeout_raw() -> Optional[str]:
-    """Raw watchdog timeout; the off-value semantics live in
-    :func:`bagua_tpu.watchdog.get_comm_timeout_s`.  None means UNSET —
-    an explicitly empty value passes through: ``""`` is one of the
-    watchdog's documented off-values, so collapsing it to None (the
-    default-300s path) would silently re-enable the watchdog."""
-    return os.environ.get("BAGUA_COMM_TIMEOUT_S")
+def get_comm_timeout_s() -> Optional[float]:
+    """Hang-watchdog timeout in seconds, or None when disabled — the
+    registry-backed accessor behind
+    :func:`bagua_tpu.watchdog.get_comm_timeout_s`."""
+    return env_seconds_or_off("BAGUA_COMM_TIMEOUT_S")
+
+
+def get_grad_guard_mode() -> str:
+    """Gradient-health sentinel policy: ``off`` (default), ``warn``,
+    ``skip`` (rewind unhealthy steps), or ``abort``."""
+    return env_enum("BAGUA_GRAD_GUARD")
+
+
+def get_fault_plan_raw() -> Optional[str]:
+    """Raw JSON fault-injection plan (None when unset); parsing lives in
+    :mod:`bagua_tpu.faults.inject`."""
+    return _raw("BAGUA_FAULT_PLAN")
 
 
 def get_bagua_service_port() -> int:

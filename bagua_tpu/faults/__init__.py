@@ -1,0 +1,23 @@
+"""Deterministic fault injection + the in-band defenses it proves out.
+
+The reference Bagua survives faults with one blunt instrument — panic after
+a 300 s comm timeout and gang-restart (bagua-core-internal/src/lib.rs:255-265).
+This package makes every recovery path in bagua_tpu *exercisable on demand*:
+a seeded injection registry (:mod:`bagua_tpu.faults.inject`) arms named
+fault points inside the real store/heartbeat/checkpoint/watchdog/step code,
+and ``scripts/chaos_drill.py`` / ``tests/test_faults.py`` drive the full
+matrix in-process on the cpu-sim mesh.  See docs/robustness.md for the
+failure-mode catalog (fault point → detector → recovery → drill).
+"""
+
+from .inject import (  # noqa: F401
+    FAULT_POINTS,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    InjectedStoreError,
+    clear_plan,
+    fault_scope,
+    get_plan,
+    set_plan,
+)

@@ -1,0 +1,430 @@
+"""Seeded fault-injection registry: named points inside the real code paths.
+
+Every defense in bagua_tpu (store retry, lease expiry, checkpoint fallback
+restore, hang watchdog, gradient guard) is reachable from a *named injection
+point* armed via ``BAGUA_FAULT_PLAN`` (a JSON list of specs) or
+programmatically (:func:`fault_scope` / :func:`set_plan`).  Injection is
+deterministic — triggers are step numbers or op counts, corruption offsets
+come from each spec's seed — so a chaos drill is exactly repeatable, unlike
+the process-killing elastic drill.
+
+Points and what firing them does:
+
+======================  =====================================================
+``store.op``            the next ``_RestartStore`` op raises a (retryable)
+                        :class:`InjectedStoreError` — exercises the
+                        reconnect-and-retry path (distributed/run.py)
+``elastic.heartbeat``   :class:`~bagua_tpu.elastic.membership.LeaseHeartbeat`
+                        drops beats — the coordinator's lease expires and the
+                        world shrinks
+``ckpt.write``          deterministically corrupts (or tears) the just-saved
+                        checkpoint's largest data file — restore must fall
+                        back to the previous verified step
+``ckpt.sidecar``        corrupts/truncates the layout sidecar JSON
+``collective.hang``     wedges the watchdog waiter's readback inside a
+                        watched section — the monitor must fire, abort, and
+                        recover via ``reset_abort``
+``grad.poison``         traced: injects NaN/Inf into a chosen bucket's
+                        gradient at a chosen step inside the compiled train
+                        step — the gradient-health sentinel must detect and
+                        (policy permitting) skip it
+======================  =====================================================
+
+Every armed/fired/recovered event lands in
+:data:`bagua_tpu.telemetry.counters` under ``faults/<point>/{armed,fired,
+recovered}``.  The hooks are cheap no-ops while no plan is armed (one
+``None`` check), so production code keeps them unconditionally.
+
+This module must stay import-light (no jax): the launcher and the watchdog
+waiter thread consume it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from .. import env as _env
+from ..telemetry import counters
+
+logger = logging.getLogger(__name__)
+
+FAULT_POINTS = (
+    "store.op",
+    "elastic.heartbeat",
+    "ckpt.write",
+    "ckpt.sidecar",
+    "collective.hang",
+    "grad.poison",
+)
+
+#: default fault kind per point (the only kind most points support)
+_DEFAULT_KINDS = {
+    "store.op": "error",
+    "elastic.heartbeat": "drop",
+    "ckpt.write": "corrupt",
+    "ckpt.sidecar": "truncate",
+    "collective.hang": "hang",
+    "grad.poison": "nan",
+}
+
+_VALID_KINDS = {
+    "store.op": ("error",),
+    "elastic.heartbeat": ("drop",),
+    "ckpt.write": ("corrupt", "torn"),
+    "ckpt.sidecar": ("truncate", "corrupt"),
+    "collective.hang": ("hang",),
+    "grad.poison": ("nan", "inf"),
+}
+
+
+class InjectedFault(Exception):
+    """Marker base for every injected failure, so defense code can tell an
+    injected fault from a real one when recording recoveries."""
+
+
+class InjectedStoreError(InjectedFault, ConnectionError):
+    """Injected store flake — a ``ConnectionError`` subclass so the
+    production retry path (``_STORE_RETRY_ERRORS``) catches it exactly like
+    a real transient socket failure."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One armed fault.  ``step`` triggers step-keyed points (``grad.poison``
+    fires inside the step whose traced counter equals it; ``ckpt.*`` fire on
+    the checkpoint saved at that step; None = any), ``op`` triggers op-count
+    points (the op-index at which firing starts, 0 = the first op seen).
+    ``count`` bounds total fires (-1 = unlimited); ``seed`` drives every
+    random choice (corruption offsets) so reruns are identical."""
+
+    point: str
+    kind: str = ""
+    step: Optional[int] = None
+    op: int = 0
+    count: int = 1
+    seed: int = 0
+    bucket: int = 0          # grad.poison: target bucket index
+    duration_s: float = 30.0  # collective.hang: how long to wedge
+
+    def __post_init__(self):
+        if self.point not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {self.point!r}; valid: {FAULT_POINTS}"
+            )
+        kind = self.kind or _DEFAULT_KINDS[self.point]
+        object.__setattr__(self, "kind", kind)
+        if kind not in _VALID_KINDS[self.point]:
+            raise ValueError(
+                f"fault kind {kind!r} invalid for {self.point!r}; valid: "
+                f"{_VALID_KINDS[self.point]}"
+            )
+
+    def signature(self) -> tuple:
+        """Hashable identity of the TRACED behavior this spec compiles into
+        (part of the trainer's step-cache key for ``grad.poison``).
+        ``count`` is included because a step=None spec compiles it in as
+        the fire window."""
+        return (self.point, self.kind, self.step, self.bucket, self.count)
+
+
+class FaultPlan:
+    """A set of armed :class:`FaultSpec` with per-spec runtime state (op
+    counters, fire counts).  Thread-safe — the heartbeat and watchdog
+    waiter threads query it concurrently with the main thread."""
+
+    def __init__(self, specs):
+        self.specs: Tuple[FaultSpec, ...] = tuple(
+            s if isinstance(s, FaultSpec) else FaultSpec(**s) for s in specs
+        )
+        self._lock = threading.Lock()
+        self._ops: Dict[int, int] = {i: 0 for i in range(len(self.specs))}
+        self._fires: Dict[int, int] = {i: 0 for i in range(len(self.specs))}
+
+    @classmethod
+    def from_json(cls, raw: str) -> "FaultPlan":
+        data = json.loads(raw)
+        if isinstance(data, dict):
+            data = [data]
+        if not isinstance(data, list):
+            raise ValueError(
+                "BAGUA_FAULT_PLAN must be a JSON list of fault specs"
+            )
+        return cls(data)
+
+    def arm(self) -> None:
+        for s in self.specs:
+            counters.incr(f"faults/{s.point}/armed")
+        if self.specs:
+            logger.warning(
+                "fault injection ARMED (%d specs): %s — drills/tests only",
+                len(self.specs),
+                ", ".join(f"{s.point}:{s.kind}" for s in self.specs),
+            )
+
+    def should_fire(self, point: str,
+                    step: Optional[int] = None) -> Optional[FaultSpec]:
+        """Query-and-advance: returns the spec that fires at this call (and
+        records the fire), else None.  Step-keyed specs fire when ``step``
+        matches; op-keyed specs count queries and fire from op-index
+        ``spec.op`` for ``spec.count`` consecutive queries."""
+        with self._lock:
+            for i, s in enumerate(self.specs):
+                if s.point != point:
+                    continue
+                if s.count >= 0 and self._fires[i] >= s.count:
+                    continue
+                if s.step is not None:
+                    if step is None or int(step) != int(s.step):
+                        continue
+                else:
+                    idx = self._ops[i]
+                    self._ops[i] = idx + 1
+                    if idx < s.op:
+                        continue
+                self._fires[i] += 1
+
+                counters.incr(f"faults/{point}/fired")
+                logger.warning(
+                    "fault injection: %s fired (kind=%s, fire %d/%s)",
+                    point, s.kind, self._fires[i],
+                    "inf" if s.count < 0 else s.count,
+                )
+                return s
+        return None
+
+    def note_traced_fire(self, spec: FaultSpec) -> None:
+        """Host-side accounting for TRACED faults (``grad.poison`` fires
+        inside the compiled program; the trainer calls this when the host
+        step counter crosses the armed step)."""
+        with self._lock:
+            for i, s in enumerate(self.specs):
+                if s is spec:
+                    self._fires[i] += 1
+        counters.incr(f"faults/{spec.point}/fired")
+        logger.warning("fault injection: %s fired in-step (kind=%s)",
+                       spec.point, spec.kind)
+
+    def fired(self, point: str) -> bool:
+        with self._lock:
+            return any(
+                self._fires[i] > 0
+                for i, s in enumerate(self.specs) if s.point == point
+            )
+
+    def armed_specs(self, point: str) -> Tuple[FaultSpec, ...]:
+        return tuple(s for s in self.specs if s.point == point)
+
+
+# ---- global plan ----------------------------------------------------------
+
+_PLAN: Optional[FaultPlan] = None
+_ENV_CHECKED = False
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_plan() -> Optional[FaultPlan]:
+    """The active plan: the programmatically installed one, else the
+    ``BAGUA_FAULT_PLAN`` env plan (parsed and armed once), else None."""
+    global _PLAN, _ENV_CHECKED
+    if _PLAN is not None:
+        return _PLAN
+    if _ENV_CHECKED:
+        return None
+    with _GLOBAL_LOCK:
+        if not _ENV_CHECKED:
+            _ENV_CHECKED = True
+            raw = _env.get_fault_plan_raw()
+            if raw:
+                try:
+                    plan = FaultPlan.from_json(raw)
+                except (ValueError, TypeError, json.JSONDecodeError) as e:
+                    raise ValueError(
+                        f"BAGUA_FAULT_PLAN is not a valid fault plan: {e}"
+                    ) from e
+                plan.arm()
+                _PLAN = plan
+    return _PLAN
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (and arm) a plan programmatically; ``None`` disarms."""
+    global _PLAN, _ENV_CHECKED
+    with _GLOBAL_LOCK:
+        _ENV_CHECKED = True  # programmatic control; never fall back to env
+        _PLAN = plan
+    if plan is not None:
+        plan.arm()
+
+
+def clear_plan() -> None:
+    """Disarm everything and forget the env plan was ever parsed (the next
+    :func:`get_plan` re-reads ``BAGUA_FAULT_PLAN`` — test isolation)."""
+    global _PLAN, _ENV_CHECKED
+    with _GLOBAL_LOCK:
+        _PLAN = None
+        _ENV_CHECKED = False
+
+
+@contextmanager
+def fault_scope(*specs):
+    """Arm the given specs (or one :class:`FaultPlan`) for the duration of
+    the block, restoring the previous plan after::
+
+        with fault_scope(FaultSpec("store.op", op=2)):
+            ...   # the third store op flakes, once
+    """
+    if len(specs) == 1 and isinstance(specs[0], FaultPlan):
+        plan = specs[0]
+    else:
+        plan = FaultPlan(specs)
+    global _PLAN, _ENV_CHECKED
+    with _GLOBAL_LOCK:
+        prev, prev_checked = _PLAN, _ENV_CHECKED
+        _PLAN = plan
+        _ENV_CHECKED = True
+    plan.arm()
+    try:
+        yield plan
+    finally:
+        with _GLOBAL_LOCK:
+            _PLAN, _ENV_CHECKED = prev, prev_checked
+
+
+# ---- hooks called by production code (no-ops while nothing is armed) ------
+
+
+def should_fire(point: str, step: Optional[int] = None) -> Optional[FaultSpec]:
+    plan = get_plan()
+    return plan.should_fire(point, step=step) if plan is not None else None
+
+
+def record_recovery(point: str) -> None:
+    """Defense paths call this after recovering from a failure they know
+    (or a drill knows) was injected; no-op unless the point has fired."""
+    plan = _PLAN
+    if plan is not None and plan.fired(point):
+        counters.incr(f"faults/{point}/recovered")
+
+
+def armed_traced_specs(point: str) -> Tuple[FaultSpec, ...]:
+    """Specs the trainer compiles INTO the traced step (``grad.poison``);
+    queried at trace time, so their signature is part of the step cache
+    key."""
+    plan = get_plan()
+    return plan.armed_specs(point) if plan is not None else ()
+
+
+def note_traced_fire(spec: FaultSpec) -> None:
+    plan = _PLAN
+    if plan is not None:
+        plan.note_traced_fire(spec)
+
+
+def maybe_raise_store_error(opname: str) -> None:
+    """``store.op`` hook (``_RestartStore._retry``): raise a retryable
+    injected flake before the op runs."""
+    spec = should_fire("store.op")
+    if spec is not None:
+        raise InjectedStoreError(
+            f"injected store fault on {opname} (seed={spec.seed})"
+        )
+
+
+def should_drop_heartbeat() -> bool:
+    """``elastic.heartbeat`` hook (``LeaseHeartbeat._run``): True = skip
+    this tick's beat (``count`` consecutive drops starve the lease)."""
+    return should_fire("elastic.heartbeat") is not None
+
+
+def maybe_hang(stop_event: Optional[threading.Event] = None) -> float:
+    """``collective.hang`` hook (watchdog waiter): wedge the caller for the
+    spec's duration (bounded; a stop event cuts it short so test teardown
+    never waits the full window).  Returns seconds requested (0 = no
+    fault)."""
+    spec = should_fire("collective.hang")
+    if spec is None:
+        return 0.0
+    if stop_event is not None:
+        stop_event.wait(spec.duration_s)
+    else:  # pragma: no cover - all in-repo callers pass their stop event
+        import time
+
+        time.sleep(spec.duration_s)
+    return spec.duration_s
+
+
+def maybe_corrupt_checkpoint(directory, step: int) -> bool:
+    """``ckpt.write`` hook: after the checkpoint for ``step`` became
+    durable, deterministically corrupt its largest data file (``corrupt``
+    flips seeded bytes; ``torn`` truncates to half — the mid-write crash).
+    Returns True when a corruption was applied."""
+    plan = get_plan()
+    if plan is None or not plan.armed_specs("ckpt.write"):
+        return False
+    # enumerate BEFORE consuming the fire: recording a fired count for a
+    # step whose files are gone (retention pruned, empty dir) would exhaust
+    # a single-shot spec and let a drill validate a fault that never
+    # actually landed on disk
+    root = os.path.join(str(directory), str(int(step)))
+    candidates = []
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            p = os.path.join(dirpath, f)
+            try:
+                size = os.path.getsize(p)
+            except OSError:
+                continue
+            if size > 0:
+                candidates.append((size, p))
+    if not candidates:
+        logger.warning("ckpt.write injection: no files under %s — "
+                       "fire not consumed", root)
+        return False
+    spec = should_fire("ckpt.write", step=int(step))
+    if spec is None:
+        return False
+    # the largest file holds the array payload: corrupting it guarantees
+    # either an unreadable checkpoint or a digest mismatch at restore
+    candidates.sort(key=lambda t: (-t[0], t[1]))
+    size, target = candidates[0]
+    if spec.kind == "torn":
+        with open(target, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        logger.warning("ckpt.write injection: tore %s to %d bytes",
+                       target, max(1, size // 2))
+        return True
+    rng = random.Random(spec.seed)
+    with open(target, "r+b") as f:
+        data = bytearray(f.read())
+        n = min(64, len(data))
+        for _ in range(n):
+            data[rng.randrange(len(data))] ^= 0xFF
+        f.seek(0)
+        f.write(bytes(data))
+    logger.warning("ckpt.write injection: flipped %d bytes in %s", n, target)
+    return True
+
+
+def maybe_corrupt_sidecar(path, step: int) -> bool:
+    """``ckpt.sidecar`` hook: corrupt the just-written layout sidecar
+    (``truncate`` leaves torn JSON; ``corrupt`` replaces it with garbage)."""
+    spec = should_fire("ckpt.sidecar", step=int(step))
+    if spec is None:
+        return False
+    try:
+        text = path.read_text()
+    except OSError:  # pragma: no cover - fs-backend dependent
+        return False
+    if spec.kind == "truncate":
+        path.write_text(text[: max(1, len(text) // 2)])
+    else:
+        path.write_text("\x00not json\x00")
+    logger.warning("ckpt.sidecar injection: %s %s", spec.kind, path)
+    return True

@@ -39,21 +39,21 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Optional
 
+from .faults import inject as _inject
+
 logger = logging.getLogger(__name__)
 
 DEFAULT_TIMEOUT_S = 300.0  # the reference's comm monitor bound (lib.rs:255)
-_OFF_VALUES = ("", "0", "off", "false", "no", "none")
 
 
 def get_comm_timeout_s() -> Optional[float]:
+    """Watchdog timeout in seconds, or None when disabled.  The off-value
+    semantics (``0``/``off``/``false``/``no``/``none``/empty) live in the
+    env registry's :func:`bagua_tpu.env.env_seconds_or_off` accessor, so
+    ``bagua-lint``'s registry coverage stays total."""
     from . import env
 
-    v = env.get_comm_timeout_raw()
-    if v is None:
-        return DEFAULT_TIMEOUT_S
-    if v.strip().lower() in _OFF_VALUES:
-        return None
-    return float(v)
+    return env.get_comm_timeout_s()
 
 
 class HangWatchdog:
@@ -79,7 +79,7 @@ class HangWatchdog:
         self.action = action
         self.fired = threading.Event()  # informational latch (never cleared)
         self._armed = True  # re-arms when all overdue sections clear
-        self._active: Dict[int, tuple] = {}
+        self._active: Dict[object, tuple] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._queue: "queue.Queue" = queue.Queue(maxsize=self._QUEUE_MAX)
@@ -92,7 +92,11 @@ class HangWatchdog:
 
     @contextmanager
     def watch(self, label: str = "comm"):
-        token = threading.get_ident()
+        # token is a fresh object per entry, NOT the thread id: keying by
+        # get_ident() made an inner (nested) watch clobber the outer entry
+        # and its exit pop the shared key — leaving the outer section
+        # unwatched for the rest of its run
+        token = object()
         with self._lock:
             self._active[token] = (label, time.monotonic())
         try:
@@ -128,6 +132,11 @@ class HangWatchdog:
             except queue.Empty:
                 continue
             with self.watch(label):
+                # chaos hook: an armed ``collective.hang`` fault wedges
+                # this readback inside the watched section — exactly the
+                # signature of a cross-rank collective deadlock (bounded
+                # by the spec's duration; the stop event cuts it short)
+                _inject.maybe_hang(stop_event=self._stop)
                 try:
                     # host readback: the reliable fence.  Multi-process
                     # global arrays can't be fetched whole — their LOCAL
@@ -227,7 +236,10 @@ _GLOBAL_LOCK = threading.Lock()
 def get_global_watchdog(timeout_s: float) -> HangWatchdog:
     """Process-wide watchdog (one monitor thread no matter how many trainers
     exist — the reference also runs ONE comm monitor per backend process,
-    lib.rs:255-265).  The first caller's timeout wins."""
+    lib.rs:255-265).  When later callers ask for a different timeout the
+    STRICTER (smaller) one is adopted — silently keeping the first caller's
+    looser bound would leave the later trainer under-protected — and the
+    difference is logged either way."""
     global _GLOBAL
     with _GLOBAL_LOCK:
         if _GLOBAL is None:
@@ -236,4 +248,13 @@ def get_global_watchdog(timeout_s: float) -> HangWatchdog:
             # killed mid-readback inside PJRT aborts the whole process at
             # exit (SIGABRT after a perfectly good run)
             atexit.register(_GLOBAL.stop)
+        elif float(timeout_s) != _GLOBAL.timeout_s:
+            adopted = min(float(timeout_s), _GLOBAL.timeout_s)
+            logger.warning(
+                "get_global_watchdog: requested timeout %.0f s differs from "
+                "the active %.0f s (one watchdog per process); adopting the "
+                "stricter %.0f s",
+                timeout_s, _GLOBAL.timeout_s, adopted,
+            )
+            _GLOBAL.timeout_s = adopted
         return _GLOBAL

@@ -24,8 +24,16 @@ echo "=== generated docs in sync (API reference + env-var table) ==="
 JAX_PLATFORMS=cpu python scripts/gen_api_docs.py --check
 JAX_PLATFORMS=cpu python scripts/gen_env_docs.py --check
 
+echo "=== chaos fast subset (fault injection -> detection -> recovery) ==="
+# The deterministic slice of scripts/chaos_drill.py: every injection point
+# fires, every detector sees it, every recovery completes.  The committed
+# CHAOS_DRILL.json full-matrix record is schema-gated in
+# tests/test_bench_sanity.py; regenerate it with scripts/chaos_drill.py.
+python -m pytest tests/test_faults.py -q
+
 echo "=== unit + integration tests (8-device CPU mesh) ==="
-python -m pytest tests/ -q
+# test_faults.py already ran as the named chaos gate above
+python -m pytest tests/ -q --ignore=tests/test_faults.py
 
 echo "=== multichip dryrun (virtual CPU mesh) ==="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \

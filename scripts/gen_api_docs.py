@@ -37,6 +37,7 @@ MODULES = [
     "bagua_tpu.tensor",
     "bagua_tpu.checkpoint",
     "bagua_tpu.watchdog",
+    "bagua_tpu.faults.inject",
     "bagua_tpu.env",
     "bagua_tpu.telemetry",
     "bagua_tpu.profiling",

@@ -105,3 +105,40 @@ def test_bench_flat_artifact_schema():
 
     gate = by_metric["flat_resident_dispatch_gate"]
     assert "faster_path_by_config" in gate and gate["auto_default"]
+
+
+def test_chaos_drill_artifact_schema():
+    """CHAOS_DRILL.json (driver-visible artifact of scripts/chaos_drill.py):
+    the committed record must cover the full fault matrix with every fault
+    injected, detected, AND recovered — recovery paths can't rot silently
+    (mirrors the BENCH_FLAT gate; regenerate with
+    `python scripts/chaos_drill.py`)."""
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "CHAOS_DRILL.json")
+    assert os.path.exists(path), "run scripts/chaos_drill.py first"
+    record = json.load(open(path))
+    assert record["drill"] == "chaos"
+    assert record["platform"] == "cpu-sim" and record["n_devices"] == 8
+    required = {
+        "store_flake_retry",
+        "heartbeat_loss_lease_expiry",
+        "checkpoint_corruption_fallback_restore",
+        "nan_grad_skip_loss_continuity",
+        "grad_guard_on_goldens_unchanged",
+        "collective_hang_watchdog_recovery",
+    }
+    assert required <= set(record["faults"]), sorted(record["faults"])
+    for name, fault in record["faults"].items():
+        assert fault["injected"] is True, name
+        assert fault["detected"] is True, (name, fault["details"])
+        assert fault["recovered"] is True, (name, fault["details"])
+    # the matrix-level verdict and the telemetry trail both recorded
+    assert record["pass"] is True
+    counters = record["counters"]
+    for point in ("store.op", "elastic.heartbeat", "ckpt.write",
+                  "grad.poison", "collective.hang"):
+        assert counters.get(f"faults/{point}/fired", 0) >= 1, point
+        assert counters.get(f"faults/{point}/recovered", 0) >= 1, point

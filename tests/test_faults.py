@@ -1,0 +1,567 @@
+"""Deterministic fault injection + the defenses it proves out (fast subset).
+
+The full matrix (store flake, heartbeat loss, checkpoint corruption, NaN
+poison, collective hang) runs in-process in ``scripts/chaos_drill.py``; this
+file is the CI-fast slice: every injection point fires deterministically,
+every detector sees it, every recovery path completes — plus the watchdog
+satellites (nested ``watch`` sections, global-watchdog timeout adoption,
+abort → ``reset_abort`` → resume under overlap + flat-resident).
+"""
+
+import contextlib
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import bagua_tpu
+from bagua_tpu import telemetry
+from bagua_tpu.algorithms import GradientAllReduceAlgorithm
+from bagua_tpu.checkpoint import (
+    BaguaCheckpointManager,
+    CheckpointIntegrityError,
+    compute_state_digest,
+)
+from bagua_tpu.core.backend import BaguaTrainer
+from bagua_tpu.faults import inject
+from bagua_tpu.faults.inject import FaultPlan, FaultSpec, fault_scope
+from bagua_tpu.models.mlp import MLP
+from bagua_tpu.parallel.mesh import build_mesh
+from bagua_tpu.watchdog import HangWatchdog
+
+N_DEVICES = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    inject.clear_plan()
+    bagua_tpu.reset_abort()
+    yield
+    inject.clear_plan()
+    bagua_tpu.reset_abort()
+
+
+def _delta(before, name):
+    return telemetry.counters.get(name) - before.get(name, 0)
+
+
+# ---- injection registry ---------------------------------------------------
+
+
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultSpec("no.such.point")
+    with pytest.raises(ValueError, match="invalid for"):
+        FaultSpec("store.op", kind="nan")
+    assert FaultSpec("grad.poison").kind == "nan"  # per-point default
+    assert FaultSpec("ckpt.write").kind == "corrupt"
+
+
+def test_op_count_trigger_and_count_bound():
+    plan = FaultPlan([FaultSpec("store.op", op=2, count=2)])
+    fires = [plan.should_fire("store.op") is not None for _ in range(6)]
+    # ops 0,1 pass; ops 2,3 fire; exhausted afterwards
+    assert fires == [False, False, True, True, False, False]
+
+
+def test_step_trigger_ignores_other_steps():
+    plan = FaultPlan([FaultSpec("ckpt.write", step=5)])
+    assert plan.should_fire("ckpt.write", step=4) is None
+    assert plan.should_fire("ckpt.write", step=5) is not None
+    assert plan.should_fire("ckpt.write", step=5) is None  # count=1 spent
+
+
+def test_env_plan_parsing_and_counters(monkeypatch):
+    before = telemetry.counters.snapshot()
+    monkeypatch.setenv(
+        "BAGUA_FAULT_PLAN",
+        '[{"point": "store.op", "op": 0}, {"point": "grad.poison", '
+        '"step": 3, "kind": "inf", "bucket": 1}]',
+    )
+    inject.clear_plan()
+    plan = inject.get_plan()
+    assert plan is not None and len(plan.specs) == 2
+    assert plan.specs[1].kind == "inf" and plan.specs[1].bucket == 1
+    assert _delta(before, "faults/store.op/armed") == 1
+    assert _delta(before, "faults/grad.poison/armed") == 1
+
+
+def test_env_plan_invalid_raises(monkeypatch):
+    monkeypatch.setenv("BAGUA_FAULT_PLAN", "{not json")
+    inject.clear_plan()
+    with pytest.raises(ValueError, match="BAGUA_FAULT_PLAN"):
+        inject.get_plan()
+
+
+def test_fault_scope_restores_previous_plan():
+    assert inject.get_plan() is None
+    with fault_scope(FaultSpec("store.op")) as plan:
+        assert inject.get_plan() is plan
+        with fault_scope(FaultSpec("ckpt.write")) as inner:
+            assert inject.get_plan() is inner
+        assert inject.get_plan() is plan
+    assert inject.get_plan() is None
+
+
+# ---- store.op: retry path -------------------------------------------------
+
+
+def test_store_flake_recovers_via_retry(monkeypatch):
+    from bagua_tpu.contrib.utils.store import InMemoryStore
+    from bagua_tpu.distributed import run as run_mod
+
+    backing = InMemoryStore()
+    monkeypatch.setattr(
+        run_mod, "_connect_restart_store",
+        lambda args, timeout_s=60.0: backing,
+    )
+    store = run_mod._RestartStore(args=None)
+    store.set("k", "v")
+    before = telemetry.counters.snapshot()
+    with fault_scope(FaultSpec("store.op")):
+        assert store.get("k") == "v"  # injected flake, then retry succeeds
+    assert _delta(before, "faults/store.op/fired") == 1
+    assert _delta(before, "faults/store.op/recovered") == 1
+
+
+# ---- elastic.heartbeat: lease expiry -------------------------------------
+
+
+def test_heartbeat_drop_expires_lease():
+    from bagua_tpu.contrib.utils.store import InMemoryStore
+    from bagua_tpu.elastic.membership import (
+        LeaseHeartbeat,
+        LeaseTracker,
+        MembershipClient,
+    )
+
+    store = InMemoryStore()
+    client = MembershipClient(store, node_id=0, max_nnodes=1)
+    hb = LeaseHeartbeat(lambda: store, node_id=0, epoch=0,
+                        interval_s=0.05).start()
+    try:
+        deadline = time.time() + 5
+        while client.read_beats(0, [0])[0] is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert client.read_beats(0, [0])[0] is not None, "no beats arrived"
+        tracker = LeaseTracker(client, epoch=0, member_ids=[0], ttl_s=0.4)
+        assert tracker.poll() == []  # healthy while beating
+        before = telemetry.counters.snapshot()
+        with fault_scope(FaultSpec("elastic.heartbeat", count=-1)):
+            deadline = time.time() + 5
+            expired = []
+            while not expired and time.time() < deadline:
+                time.sleep(0.1)
+                expired = tracker.poll()
+            assert expired == [0], "starved lease never expired"
+            assert _delta(before, "faults/elastic.heartbeat/fired") >= 1
+            inject.record_recovery("elastic.heartbeat")  # drill accounting
+        assert _delta(before, "faults/elastic.heartbeat/recovered") == 1
+    finally:
+        hb.stop()
+
+
+# ---- checkpoint integrity chain ------------------------------------------
+
+
+def _state(v: float):
+    return {
+        "w": jnp.arange(256, dtype=jnp.float32) * v,
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def test_state_digest_is_content_keyed():
+    a, b = compute_state_digest(_state(1.0)), compute_state_digest(_state(1.0))
+    assert a == b and a["algo"] == "sha256"
+    assert compute_state_digest(_state(2.0))["digest"] != a["digest"]
+
+
+def test_corrupted_latest_falls_back_to_previous_verified(tmp_path):
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False,
+                                 max_to_keep=5)
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))
+    with fault_scope(FaultSpec("ckpt.write", step=3)):
+        mgr.save(3, _state(3.0))
+    before = telemetry.counters.snapshot()
+    step, restored = mgr.try_restore(_state(0.0))
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(_state(2.0)["w"]))
+    assert _delta(before, "ckpt/integrity_failures") >= 1
+    assert _delta(before, "ckpt/fallback_restores") == 1
+    # explicit-step restores never fall back: the corruption raises
+    with pytest.raises(CheckpointIntegrityError):
+        mgr.restore(_state(0.0), step=3)
+    mgr.close()
+
+
+def test_torn_checkpoint_falls_back(tmp_path):
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False,
+                                 max_to_keep=5)
+    mgr.save(1, _state(1.0))
+    with fault_scope(FaultSpec("ckpt.write", step=2, kind="torn")):
+        mgr.save(2, _state(2.0))
+    step, restored = mgr.try_restore(_state(0.0))
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(_state(1.0)["w"]))
+    mgr.close()
+
+
+def test_corrupted_sidecar_falls_back(tmp_path):
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False,
+                                 max_to_keep=5)
+    mgr.save(1, _state(1.0))
+    with fault_scope(FaultSpec("ckpt.sidecar", step=2)):
+        mgr.save(2, _state(2.0))
+    step, _ = mgr.try_restore(_state(0.0))
+    assert step == 1
+    mgr.close()
+
+
+def test_all_checkpoints_corrupt_raises_loudly(tmp_path):
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    with fault_scope(FaultSpec("ckpt.write", step=1)):
+        mgr.save(1, _state(1.0))
+    with pytest.raises(CheckpointIntegrityError, match="passed verification"):
+        mgr.try_restore(_state(0.0))
+    mgr.close()
+
+
+def test_healthy_restore_verifies_digest(tmp_path):
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    mgr.save(1, _state(1.0))
+    before = telemetry.counters.snapshot()
+    step, _ = mgr.restore(_state(0.0))
+    assert step == 1
+    assert _delta(before, "ckpt/verified_restores") == 1
+    mgr.close()
+
+
+def test_sidecar_publish_is_atomic(tmp_path):
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    mgr.save(1, _state(1.0), metadata={"layout": "leaf"})
+    files = [p.name for p in (tmp_path / "ckpt").iterdir()]
+    assert "1.layout.json" in files
+    assert not [f for f in files if f.endswith(".tmp")]
+    assert mgr.read_layout(1)["layout"] == "leaf"
+    assert "integrity" in mgr.read_layout(1)
+    mgr.close()
+
+
+def test_async_save_digest_rides_deferred_sidecar(tmp_path):
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    s = _state(1.0)
+    mgr.save(1, s)
+    mgr.wait()
+    step, restored = mgr.try_restore(_state(0.0))
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(s["w"]))
+    mgr.close()
+
+
+# ---- gradient-health sentinel --------------------------------------------
+
+
+def _make_trainer(guard="off", poison_step=None, n_steps=0, **kw):
+    mesh = build_mesh({"dp": N_DEVICES})
+    model = MLP(features=(16, 8))
+    x = jax.random.normal(jax.random.PRNGKey(0), (N_DEVICES * 2, 4))
+    y = jnp.argmax(
+        x @ jax.random.normal(jax.random.PRNGKey(1), (4, 8)), -1
+    )
+    params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]
+        ).mean()
+
+    cm = (
+        fault_scope(FaultSpec("grad.poison", step=poison_step))
+        if poison_step is not None else contextlib.nullcontext()
+    )
+    with cm:
+        t = BaguaTrainer(loss_fn, optax.sgd(0.1),
+                         GradientAllReduceAlgorithm(), mesh=mesh,
+                         autotune=False, grad_guard=guard, **kw)
+        s = t.init(params)
+        b = t.shard_batch({"x": x, "y": y})
+        loss = None
+        for _ in range(n_steps):
+            s, loss = t.train_step(s, b)
+        if guard != "off" and n_steps:
+            t.flush_grad_health()
+    return t, s, b, loss
+
+
+def test_guard_on_is_byte_identical_without_faults():
+    _, s_off, _, l_off = _make_trainer("off", n_steps=5)
+    t_on, s_on, _, l_on = _make_trainer("skip", n_steps=5)
+    assert float(l_off) == float(l_on)
+    for a, b in zip(jax.tree.leaves(s_off.params),
+                    jax.tree.leaves(s_on.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # healthy-run metrics surface (the unhealthy side is asserted in
+    # test_warn_policy_lets_poison_through)
+    assert float(t_on.step_metrics["grad_healthy"]) == 1.0
+    assert np.asarray(t_on.step_metrics["grad_health_buckets"]).min() == 1.0
+
+
+def test_skip_rewind_is_exact():
+    """The fixed-batch task makes skip exactness assertable bitwise: a run
+    poisoned at step 3 (rewound) must equal a clean run of one fewer step
+    — params and optimizer state untouched by the unhealthy step."""
+    _, s_clean, _, _ = _make_trainer("off", n_steps=5)
+    before = telemetry.counters.snapshot()
+    t, s_skip, _, _ = _make_trainer("skip", poison_step=3, n_steps=6)
+    assert _delta(before, "grad_guard/skipped_steps") == 1
+    assert t._guard_skips == 0  # healthy steps after the skip reset the run
+    for a, b in zip(jax.tree.leaves(s_clean.params),
+                    jax.tree.leaves(s_skip.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the step counter still advances on a skipped step
+    assert int(s_skip.step) == 6
+
+
+@pytest.mark.slow  # two accum+flat step compiles; ci.sh's unfiltered
+# chaos stage still runs it — the fast tier keeps the plain-layout twin
+def test_skip_exact_under_accum_and_flat_resident():
+    _, s_clean, _, _ = _make_trainer("off", n_steps=5, accum_steps=2,
+                                     flat_resident="on")
+    _, s_skip, _, _ = _make_trainer("skip", poison_step=3, n_steps=6,
+                                    accum_steps=2, flat_resident="on")
+    for a, b in zip(jax.tree.leaves(s_clean.params),
+                    jax.tree.leaves(s_skip.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_warn_policy_lets_poison_through():
+    before = telemetry.counters.snapshot()
+    t, s, _, loss = _make_trainer("warn", poison_step=1, n_steps=2)
+    assert _delta(before, "grad_guard/unhealthy_steps") >= 1
+    # warn only observes: the poisoned update was applied
+    leaves = [np.asarray(x) for x in jax.tree.leaves(s.params)]
+    assert not all(np.isfinite(x).all() for x in leaves)
+    # unhealthy-run metrics surface
+    assert float(t.step_metrics["grad_healthy"]) == 0.0
+    assert np.asarray(t.step_metrics["grad_health_buckets"]).min() == 0.0
+
+
+def test_abort_policy_fails_fast():
+    t, s, b, _ = _make_trainer("abort", poison_step=1, n_steps=3)
+    with pytest.raises(bagua_tpu.BaguaAborted, match="grad guard"):
+        t.train_step(s, b)
+
+
+def test_skip_budget_escalates_to_abort():
+    before = telemetry.counters.snapshot()
+    with fault_scope(FaultSpec("grad.poison", step=None, count=-1)):
+        t, s, b, _ = _make_trainer("skip", n_steps=0,
+                                   grad_guard_budget=2)
+        with pytest.raises(bagua_tpu.BaguaAborted, match="skip budget"):
+            for _ in range(10):
+                s, _ = t.train_step(s, b)
+            t.flush_grad_health()
+    assert t._guard_skips >= 2
+    assert _delta(before, "grad_guard/aborts") == 1
+
+
+def test_grad_guard_env_and_validation(monkeypatch):
+    from bagua_tpu import env
+
+    monkeypatch.setenv("BAGUA_GRAD_GUARD", "skip")
+    assert env.get_grad_guard_mode() == "skip"
+    monkeypatch.setenv("BAGUA_GRAD_GUARD", "bogus")
+    with pytest.raises(ValueError, match="BAGUA_GRAD_GUARD"):
+        env.get_grad_guard_mode()
+    with pytest.raises(ValueError, match="grad_guard must be"):
+        _make_trainer("bogus")
+
+
+# ---- watchdog satellites + collective.hang --------------------------------
+
+
+def test_nested_watch_keeps_outer_section(monkeypatch):
+    """Regression (keyed-by-thread-id bug): an inner watch() on the same
+    thread used to clobber the outer entry and un-watch it on exit."""
+    wd = HangWatchdog(timeout_s=300, action="log")
+    try:
+        with wd.watch("outer"):
+            with wd.watch("inner"):
+                assert len(wd._active) == 2
+            labels = [label for label, _ in wd._active.values()]
+            assert labels == ["outer"], (
+                "inner watch exit dropped the outer section"
+            )
+    finally:
+        wd.stop()
+    assert not wd._active
+
+
+def test_global_watchdog_adopts_stricter_timeout(monkeypatch, caplog):
+    import logging
+
+    import bagua_tpu.watchdog as wdmod
+
+    monkeypatch.setattr(wdmod, "_GLOBAL", None)
+    wd = wdmod.get_global_watchdog(300.0)
+    try:
+        with caplog.at_level(logging.WARNING, logger="bagua_tpu.watchdog"):
+            wd2 = wdmod.get_global_watchdog(120.0)
+            assert wd2 is wd and wd.timeout_s == 120.0  # stricter adopted
+            wd3 = wdmod.get_global_watchdog(600.0)
+            assert wd3.timeout_s == 120.0  # looser request does not loosen
+        assert sum("stricter" in r.message for r in caplog.records) == 2
+    finally:
+        wd.stop()
+
+
+def test_injected_hang_fires_watchdog_and_recovers(monkeypatch):
+    """collective.hang wedges the waiter's readback inside a watched
+    section -> the monitor fires and raises the abort flag (abort mode) ->
+    the section clears when the bounded hang ends -> reset_abort recovers
+    and records the recovery."""
+    # the process-global watchdog's waiter runs the same hook and would
+    # race this test's instance for the single armed fire
+    monkeypatch.setenv("BAGUA_COMM_TIMEOUT_S", "off")
+    wd = HangWatchdog(timeout_s=0.3, action="abort")
+    before = telemetry.counters.snapshot()
+    try:
+        with fault_scope(FaultSpec("collective.hang", duration_s=2.5)):
+            wd.watch_result(np.zeros(()), "wedged-step")
+            deadline = time.time() + 10
+            while not wd.fired.is_set() and time.time() < deadline:
+                time.sleep(0.05)
+            assert wd.fired.is_set(), "watchdog never fired on the hang"
+            assert bagua_tpu.is_aborted()
+            # wait for the bounded hang to clear the watched section
+            deadline = time.time() + 10
+            while wd._active and time.time() < deadline:
+                time.sleep(0.05)
+            bagua_tpu.reset_abort()
+            assert _delta(before, "faults/collective.hang/fired") == 1
+            assert _delta(before, "faults/collective.hang/recovered") == 1
+    finally:
+        wd.stop()
+        bagua_tpu.reset_abort()
+
+
+@pytest.mark.slow  # overlap+flat compile plus two multi-second hang
+# episodes; ci.sh's unfiltered chaos stage runs it every time
+def test_abort_recovery_resumes_overlap_flat_trainer(monkeypatch):
+    """Satellite: watchdog abort -> reset_abort -> resume, exercised on the
+    overlap='on' + flat_resident='on' step construction (previously only
+    covered at seed defaults), including a SECOND hang episode re-arming."""
+    monkeypatch.setenv("BAGUA_COMM_TIMEOUT_S", "off")  # see hang test above
+    t, s, b, _ = _make_trainer("off", n_steps=2, accum_steps=2,
+                               overlap="on", flat_resident="on")
+    wd = HangWatchdog(timeout_s=0.3, action="abort")
+    try:
+        for episode in range(2):  # second episode proves re-arming
+            # the monitor re-arms on its next tick after all overdue
+            # sections clear (watchdog.py re-arm path) — wait for it
+            deadline = time.time() + 10
+            while not wd._armed and time.time() < deadline:
+                time.sleep(0.05)
+            assert wd._armed, f"watchdog never re-armed before episode {episode}"
+            with fault_scope(FaultSpec("collective.hang", duration_s=1.2)):
+                wd.fired.clear()
+                wd.watch_result(np.zeros(()), f"wedge-{episode}")
+                deadline = time.time() + 10
+                while not bagua_tpu.is_aborted() and time.time() < deadline:
+                    time.sleep(0.05)
+                assert wd.fired.is_set(), f"episode {episode} never fired"
+                assert bagua_tpu.is_aborted(), (
+                    f"episode {episode} never raised the abort flag"
+                )
+                with pytest.raises(bagua_tpu.BaguaAborted):
+                    t.train_step(s, b)
+                deadline = time.time() + 10
+                while wd._active and time.time() < deadline:
+                    time.sleep(0.05)
+            bagua_tpu.reset_abort()
+            for _ in range(2):
+                s, loss = t.train_step(s, b)
+            assert np.isfinite(float(loss))
+    finally:
+        wd.stop()
+
+
+def test_env_seconds_or_off_accessor(monkeypatch):
+    from bagua_tpu import env
+    from bagua_tpu.watchdog import get_comm_timeout_s
+
+    monkeypatch.delenv("BAGUA_COMM_TIMEOUT_S", raising=False)
+    assert get_comm_timeout_s() == 300.0
+    for off in ("0", "off", "FALSE", "none", "no", ""):
+        monkeypatch.setenv("BAGUA_COMM_TIMEOUT_S", off)
+        assert get_comm_timeout_s() is None, off
+    monkeypatch.setenv("BAGUA_COMM_TIMEOUT_S", "42.5")
+    assert get_comm_timeout_s() == 42.5
+    monkeypatch.setenv("BAGUA_COMM_TIMEOUT_S", "soon")
+    with pytest.raises(ValueError, match="BAGUA_COMM_TIMEOUT_S"):
+        env.env_seconds_or_off("BAGUA_COMM_TIMEOUT_S")
+
+
+def test_trainer_restore_checkpoint_falls_back(tmp_path):
+    """The trainer's layout-aware restore path must ride the same
+    integrity fallback as the manager: a corrupted latest checkpoint lands
+    on the previous verified step (drive-script regression)."""
+    mgr = BaguaCheckpointManager(str(tmp_path / "ckpt"), async_save=False,
+                                 max_to_keep=5)
+    t, s, b, _ = _make_trainer("off", n_steps=2)
+    t.save_checkpoint(mgr, 1, s)
+    good = [np.asarray(v).copy() for v in jax.tree.leaves(s.params)]
+    with fault_scope(FaultSpec("ckpt.write", step=2, kind="torn")):
+        s, _ = t.train_step(s, b)
+        t.save_checkpoint(mgr, 2, s)
+    step, restored = t.restore_checkpoint(mgr, s)
+    assert step == 1
+    for a, v in zip(good, jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(a, np.asarray(v))
+    s2, loss = t.train_step(restored, b)
+    assert np.isfinite(float(loss))
+    mgr.close()
+
+
+def test_abort_policy_discards_stale_verdicts(tmp_path):
+    """After a grad-guard abort, queued verdicts (from steps run on the
+    poisoned state) must not re-trip the guard once the operator resets
+    the flag and restores a clean state (drive-script regression)."""
+    t, s, b, _ = _make_trainer("abort", poison_step=1, n_steps=3)
+    with pytest.raises(bagua_tpu.BaguaAborted):
+        t.train_step(s, b)
+    bagua_tpu.reset_abort()
+    assert t._pending_health == []  # stale verdicts dropped at abort
+    # recovery contract: reset the flag AND restore a clean state (abort
+    # is observe-only — the poisoned update was applied to `s`)
+    model = MLP(features=(16, 8))
+    fresh = model.init(
+        jax.random.PRNGKey(2),
+        jax.random.normal(jax.random.PRNGKey(0), (2, 4)),
+    )["params"]
+    s2, loss = t.train_step(t.init(fresh), b)
+    t.flush_grad_health()
+    assert np.isfinite(float(loss))
+    assert not bagua_tpu.is_aborted()
+
+
+def test_default_poison_count_bounds_traced_fires():
+    """A step=None grad.poison spec compiles its count in as the fire
+    window (review regression): the default count=1 poisons exactly the
+    first step, not every step forever."""
+    before = telemetry.counters.snapshot()
+    with fault_scope(FaultSpec("grad.poison")):  # step=None, count=1
+        t, s, b, loss = _make_trainer("skip", n_steps=4)
+    assert _delta(before, "grad_guard/skipped_steps") == 1
+    assert _delta(before, "faults/grad.poison/fired") == 1
+    assert np.isfinite(float(loss))
