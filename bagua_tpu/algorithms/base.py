@@ -181,6 +181,14 @@ class Algorithm:
     #: this family (explicit ``flat_resident="on"`` always wins) — the
     #: measured-record gate, like :attr:`overlap_auto` (BENCH_FLAT.json).
     flat_resident_auto: bool = True
+    #: Straggler coupling: True when every train step synchronizes with
+    #: every rank (a per-step gradient collective), so a slow peer gates
+    #: the step — the ``step.straggle`` fault point then dilates each step.
+    #: Asynchronous families whose steps run on stale local weights set
+    #: False: a straggler binds them only at their own negotiated
+    #: boundaries (they call :func:`bagua_tpu.faults.inject.maybe_straggle`
+    #: there themselves).
+    straggler_gates_step: bool = True
     #: Gradient-health sentinel contract: True when the family's POST-comm
     #: gradient representation is bitwise-identical on every rank (a plain
     #: summed/averaged bucket reduce), so the per-bucket ``isfinite``
@@ -310,3 +318,12 @@ class Algorithm:
         asynchronous algorithms swap weights (reference async
         init_forward_pre_hook's lock, async_model_average.py:156-168)."""
         return state
+
+    def on_restore(self, trainer) -> None:
+        """Host-side hook run after ``BaguaTrainer.restore_checkpoint``
+        materialized a state for this trainer (elastic restarts included).
+        Algorithms carrying host-side schedule state tied to the PREVIOUS
+        run (async model averaging's in-flight round, launch anchor, agreed
+        period) reset it here so the resumed run starts from a clean
+        window instead of consuming stale cross-resize state."""
+        return None

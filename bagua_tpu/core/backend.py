@@ -407,6 +407,10 @@ class BaguaTrainer:
             )
         self.grad_guard_budget = int(grad_guard_budget)
         self._guard_skips = 0
+        #: monotonic count of guard rewinds (never reset): async model
+        #: averaging compares it across a round's flight window to veto
+        #: applying the round's delta on top of a rewound state
+        self._guard_rewinds_total = 0
         self._pending_health: list = []
         #: per-step observability surface (host side): after each
         #: ``train_step`` under an active grad guard, ``grad_healthy`` is
@@ -474,6 +478,11 @@ class BaguaTrainer:
         self._manual_speed = False
         self._skip_next_speed_sample = True
         self._hyperparams_signature = None
+        # host dispatch cadence (one monotonic read per step): the base
+        # step time the step.straggle fault point dilates by its factor
+        self._last_step_mono: Optional[float] = None
+        self._step_dt: Optional[float] = None
+        self._last_straggle_sleep = 0.0
 
     # ---- plan management -----------------------------------------------
 
@@ -1453,13 +1462,44 @@ class BaguaTrainer:
             self._skip_next_speed_sample = True
         return self._step_cache[key]
 
+    def measured_step_dt(self) -> Optional[float]:
+        """Host dispatch cadence of the previous step in seconds (injected
+        straggle stalls subtracted, so a dilation can never compound into
+        its own base).  Steady-state dispatch cadence equals device step
+        cadence — each dispatch consumes the previous state — which makes
+        this the honest base time for the ``step.straggle`` fault point."""
+        return self._step_dt
+
+    def note_injected_stall(self, seconds: float) -> None:
+        """Record an injected stall that happened inside the current step
+        (e.g. an async boundary's ``step.straggle`` sleep) so the next
+        cadence sample subtracts it — see :meth:`measured_step_dt`."""
+        self._last_straggle_sleep += float(seconds)
+
+    def _note_step_cadence(self) -> None:
+        now = time.monotonic()
+        if self._last_step_mono is not None:
+            dt = now - self._last_step_mono - self._last_straggle_sleep
+            if dt > 0:
+                self._step_dt = dt
+        self._last_step_mono = now
+
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         from ..communication import check_abort
+        from ..faults import inject as _inject
 
         check_abort()  # fail fast once a rank/watchdog flagged an abort
         self._step_counter += 1
         if self._profiler is not None:
             self._profiler.on_step(self._step_counter - 1)
+        # step.straggle: a slow peer gates this step only when the family's
+        # step synchronizes with every rank (per-step gradient collective);
+        # async families pay at their own negotiated boundaries instead
+        self._note_step_cadence()
+        self._last_straggle_sleep = _inject.maybe_straggle(
+            "step", base_dt=self._step_dt,
+            gated=self.algorithm.straggler_gates_step,
+        )
         state = self.algorithm.host_pre_step(self, state)
         if self.algorithm.need_reset(self._step_counter - 1):
             self._phase += 1
@@ -1577,6 +1617,7 @@ class BaguaTrainer:
             return
         bad = [i for i, v in enumerate(hv) if v <= 0.5]
         counters.incr("grad_guard/unhealthy_steps")
+        abort_msg = None
         if self.grad_guard == "warn":
             logger.warning(
                 "grad guard: step %d produced non-finite gradients "
@@ -1591,12 +1632,13 @@ class BaguaTrainer:
             # abort and restores a clean checkpoint would re-trip the
             # guard spuriously
             self._pending_health.clear()
-            abort(
+            abort_msg = (
                 f"grad guard: step {step_no} produced non-finite gradients "
                 f"(buckets {bad})"
             )
         elif self.grad_guard == "skip":
             self._guard_skips += 1
+            self._guard_rewinds_total += 1
             counters.incr("grad_guard/skipped_steps")
             _inject.record_recovery("grad.poison")
             logger.warning(
@@ -1608,12 +1650,24 @@ class BaguaTrainer:
             if self._guard_skips >= self.grad_guard_budget:
                 counters.incr("grad_guard/aborts")
                 self._pending_health.clear()
-                abort(
+                abort_msg = (
                     f"grad guard: {self._guard_skips} consecutive unhealthy "
                     f"steps reached the skip budget "
                     f"({self.grad_guard_budget}) — systematic divergence, "
                     "not a transient bad batch"
                 )
+        # surface the event to the elastic coordinator AFTER the policy
+        # counters above, so the published payload includes this event's
+        # skip/abort bookkeeping; the launcher's lease heartbeat carries
+        # these counters as a health payload and a rank producing repeated
+        # non-finite gradients can be fenced out by the epoch/resize
+        # machinery (no-op unless the launcher injected
+        # BAGUA_ELASTIC_HEALTH_FILE)
+        from ..elastic.membership import write_health_beacon
+
+        write_health_beacon()
+        if abort_msg is not None:
+            abort(abort_msg)
 
     def _note_traced_fault_fires(self, state: TrainState) -> None:
         """Host-side telemetry for traced faults: the compiled step fires
@@ -2112,13 +2166,16 @@ class BaguaTrainer:
             "world_size": int(self._comm.nranks()),
             "bucket_bytes": int(self.bucket_bytes),
             "plan_dependent": bool(self._flat_resident),
+            # recorded for every layout: stacked (per-rank) states carry a
+            # world-sized leading rank axis, which the cross-world restore
+            # paths must know about even for plan-independent leaf layouts
+            "stacked": not self.algorithm.replicated_params,
         }
         if self._flat_resident:
             # the full flat layout (bucket -> ordered (name, shape, dtype)
             # + alignment): everything restore_checkpoint needs to unpack
             # or relayout these buffers WITHOUT this trainer's plan
             meta["flat_layout"] = self._plan.layout_descriptor()
-            meta["stacked"] = not self.algorithm.replicated_params
         if getattr(self.algorithm, "sharded_opt_state", False):
             # opt-state chunk layout depends on the SHARD count, which for
             # hierarchical ZeRO is the intra size, not the world size — a
@@ -2175,8 +2232,17 @@ class BaguaTrainer:
         param pytree (elementwise optax transforms, QAdam momenta).
         Sharded-opt-state ZeRO's per-chunk states stay plan-locked — a
         cross-plan ZeRO restore raises the manager's actionable layout
-        error.  Per-rank (gossip) state converts only between identical
-        plans.  Returns ``(step, state)``."""
+        error.  Per-rank (gossip) LEAF state additionally restores across
+        an elastic WORLD RESIZE when its rank rows are bit-identical (the
+        ``AsyncModelAverageAlgorithm.sync_for_checkpoint`` protocol): row 0
+        is verified against every other row and re-tiled onto the live
+        world; rows that diverged raise actionably.  Other stacked
+        conversions stay identical-plan only.  After a successful restore
+        the algorithm's :meth:`~bagua_tpu.algorithms.base.Algorithm.
+        on_restore` hook runs — async model averaging resets its
+        negotiated schedule there, so the resumed run opens a fresh
+        calibration window instead of consuming a stale in-flight round or
+        launch anchor.  Returns ``(step, state)``."""
         if self._plan is None:
             raise RuntimeError(
                 "restore_checkpoint() needs the bucket plan — call "
@@ -2184,14 +2250,17 @@ class BaguaTrainer:
             )
         self._require_no_pending_migration("restore_checkpoint")
         if step is not None:
-            return self._restore_checkpoint_at(manager, state_like,
-                                               int(step))
-        # integrity fallback: with no explicit step, ride the manager's
-        # newest-first walk — a corrupted latest checkpoint degrades to
-        # the previous verified one instead of crashing the resume
-        return manager._restore_newest_verified(
-            lambda s: self._restore_checkpoint_at(manager, state_like, s)
-        )
+            result = self._restore_checkpoint_at(manager, state_like,
+                                                 int(step))
+        else:
+            # integrity fallback: with no explicit step, ride the manager's
+            # newest-first walk — a corrupted latest checkpoint degrades to
+            # the previous verified one instead of crashing the resume
+            result = manager._restore_newest_verified(
+                lambda s: self._restore_checkpoint_at(manager, state_like, s)
+            )
+        self.algorithm.on_restore(self)
+        return result
 
     def _restore_checkpoint_at(self, manager, state_like: TrainState,
                                step: int):
@@ -2213,6 +2282,21 @@ class BaguaTrainer:
             saved is not None
             and saved.get("plan_signature") == expected["plan_signature"]
         )
+        saved_world = (saved or {}).get("world_size")
+        if (
+            not self.algorithm.replicated_params
+            and not self._flat_resident
+            and saved_layout == "leaf"
+            and saved_world
+            and int(saved_world) != self._comm.nranks()
+        ):
+            # stacked (per-rank) leaf state across an elastic world resize:
+            # the leading rank axis is world-sized, so the direct restore
+            # would hit an opaque orbax shape mismatch — take the
+            # row-identity re-tiling path instead
+            return self._restore_stacked_resized(
+                manager, state_like, step, saved, int(saved_world)
+            )
         if saved is None or (same_layout and (saved_layout == "leaf"
                                               or same_plan)):
             return direct()
@@ -2324,6 +2408,95 @@ class BaguaTrainer:
         logger.info(
             "restore_checkpoint: converted step %s from %s layout to %s",
             step, saved_layout, expected["layout"],
+        )
+        return step, converted
+
+    def _restore_stacked_resized(self, manager, state_like: TrainState,
+                                 step: int, saved: dict, saved_world: int):
+        """Elastic world-resize restore for stacked (per-rank) LEAF states
+        — the async model-average / gossip families, whose every
+        params/opt/algo leaf carries a leading world-sized rank axis.
+
+        Protocol: the checkpoint must have been saved with rank-identical
+        rows (``AsyncModelAverageAlgorithm.sync_for_checkpoint`` — a
+        blocking synchronous model average — right before the save).  The
+        restore rebuilds the SAVED world's stacked shapes, verifies every
+        row of every leaf is bit-identical to row 0, and re-tiles row 0
+        onto the live world size.  Divergent rows raise actionably: they
+        mean per-rank replicas that genuinely cannot be resized, and
+        silently picking one row would discard other ranks' progress."""
+        from jax.sharding import NamedSharding
+
+        live_n = self._comm.nranks()
+        stacked_trees = (state_like.params, state_like.opt_state,
+                         state_like.algo_state)
+        bad = [
+            tuple(jnp.shape(x)) for x in jax.tree.leaves(stacked_trees)
+            if not jnp.ndim(x) or jnp.shape(x)[0] != live_n
+        ]
+        if bad:
+            raise ValueError(
+                f"cross-world stacked restore expects every params/opt/algo "
+                f"leaf to carry a leading rank axis of {live_n}, found "
+                f"shapes {bad[:3]} — restore at the saved world size "
+                f"({saved_world}) instead"
+            )
+
+        def to_saved(x):
+            return jax.ShapeDtypeStruct(
+                (saved_world,) + tuple(jnp.shape(x)[1:]), jnp.result_type(x)
+            )
+
+        saved_like = state_like._replace(
+            params=jax.tree.map(to_saved, state_like.params),
+            opt_state=jax.tree.map(to_saved, state_like.opt_state),
+            algo_state=jax.tree.map(to_saved, state_like.algo_state),
+        )
+        # expect the SAVED metadata: this restore deliberately targets the
+        # on-disk world; the re-tiling below moves it onto the live one
+        step, restored = manager.restore(saved_like, step=step,
+                                         expect_metadata=saved,
+                                         mesh=self.mesh)
+
+        def retile(sx, like):
+            a = np.asarray(sx)
+            row0 = a[0]
+            b0 = row0.tobytes()
+            for r in range(1, a.shape[0]):
+                if b0 != a[r].tobytes():
+                    raise ValueError(
+                        f"stacked checkpoint step {step} (world "
+                        f"{saved_world}) has DIVERGENT per-rank rows — it "
+                        "cannot restore onto a resized world "
+                        f"({live_n} ranks).  Save resize-portable async "
+                        "checkpoints via algorithm.sync_for_checkpoint("
+                        "trainer, state) (a blocking synchronous model "
+                        "average) right before save_checkpoint, or restore "
+                        "at the original world size."
+                    )
+            out = jnp.asarray(
+                np.broadcast_to(row0, (live_n,) + row0.shape).copy()
+            )
+            sh = getattr(like, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                out = jax.device_put(out, sh)
+            return out
+
+        converted = state_like._replace(
+            step=restored.step,
+            params=jax.tree.map(retile, restored.params, state_like.params),
+            opt_state=jax.tree.map(retile, restored.opt_state,
+                                   state_like.opt_state),
+            algo_state=jax.tree.map(retile, restored.algo_state,
+                                    state_like.algo_state),
+        )
+        from ..telemetry import counters
+
+        counters.incr("ckpt/stacked_resize_restores")
+        logger.info(
+            "restore_checkpoint: re-tiled stacked step %s from world %d "
+            "onto world %d (rank rows verified bit-identical)",
+            step, saved_world, live_n,
         )
         return step, converted
 

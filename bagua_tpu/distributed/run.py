@@ -41,7 +41,7 @@ import signal
 import subprocess
 import sys
 import time
-from typing import List
+from typing import List, Optional
 
 from .. import env as _env
 
@@ -144,6 +144,29 @@ def parse_args(argv=None):
     return args
 
 
+def _health_beacon_path(args, local_rank: Optional[int] = None) -> str:
+    """Health beacon file: keyed by the restart-store port (one job) and
+    the stable node id, so concurrent jobs on one host cannot cross-read
+    each other's beacons.  One file PER local rank (``local_rank`` set):
+    every worker writes only its own snapshot, so a shared file would be
+    last-writer-wins and hide all but one worker's events from the fence;
+    the heartbeat merges them via ``merged_health_source``."""
+    import tempfile
+
+    base = os.path.join(
+        tempfile.gettempdir(),
+        f"bagua_health_{args.restart_coordinator_port}_{args.node_rank}.json",
+    )
+    return base if local_rank is None else f"{base}.r{local_rank}"
+
+
+def _health_beacon_paths(args) -> List[str]:
+    """Every local worker's beacon file for this node."""
+    return [
+        _health_beacon_path(args, i) for i in range(args.nproc_per_node)
+    ]
+
+
 def build_env(args, local_rank: int, spec=None) -> dict:
     """Reference ``set_bagua_env`` (run.py:578-600) + rendezvous env.
 
@@ -206,6 +229,11 @@ def build_env(args, local_rank: int, spec=None) -> dict:
                 f"{args.master_addr}:{args.restart_coordinator_port}"),
             BAGUA_ELASTIC_MIN_NNODES=str(spec.min_nnodes),
             BAGUA_ELASTIC_MAX_NNODES=str(spec.max_nnodes),
+            # worker->launcher health channel: the trainer's grad-guard /
+            # async-staleness events land in this worker's own beacon
+            # file, and the launcher's lease heartbeat merges all local
+            # beacons and carries them to the coordinator
+            BAGUA_ELASTIC_HEALTH_FILE=_health_beacon_path(args, local_rank),
         )
     if args.simulate_cpu_devices:
         env["JAX_PLATFORMS"] = "cpu"
@@ -530,6 +558,25 @@ def monitor_elastic(args, procs, client, spec, coordinator, tracker) -> int:
                             mb.STOP_LEASE_EXPIRED, expired[0], reason,
                             rejoin=False, nodes=expired,
                         )
+                    unhealthy = tracker.unhealthy_members()
+                    if unhealthy:
+                        reason = (
+                            "heartbeat health payload over limit "
+                            f"(node(s) {unhealthy}: "
+                            + "; ".join(
+                                f"{n}={tracker.health_of(n)}"
+                                for n in unhealthy
+                            ) + ")"
+                        )
+                        client.publish_stop(
+                            epoch, mb.STOP_HEALTH, unhealthy[0],
+                            reason, rejoin=False, nodes=unhealthy,
+                        )
+                        kill_gang(procs)
+                        raise _GangStop(
+                            mb.STOP_HEALTH, unhealthy[0], reason,
+                            rejoin=False, nodes=unhealthy,
+                        )
                     standby = coordinator.standby_ids(spec)
                     if standby and spec.nnodes < spec.max_nnodes:
                         grow = standby[: spec.max_nnodes - spec.nnodes]
@@ -600,6 +647,7 @@ def run_elastic(args) -> int:
         mb.STOP_LEASE_EXPIRED: "elastic/lease_expired",
         mb.STOP_LEAVE: "elastic/leaves",
         mb.STOP_RESIZE: "elastic/resizes",
+        mb.STOP_HEALTH: "elastic/health_fenced",
     }
     try:
         store = _RestartStore(args)
@@ -661,11 +709,24 @@ def run_elastic(args) -> int:
                 spec.epoch, spec.nnodes, args.node_rank,
                 spec.rank_of(args.node_rank),
             )
+            # fresh attempt, fresh health: a stale beacon from the previous
+            # epoch's workers would instantly re-report old events (and with
+            # fencing armed, re-fence a node that just restarted clean)
+            beacons = _health_beacon_paths(args)
+            for beacon in beacons:
+                try:
+                    os.unlink(beacon)
+                except OSError:
+                    pass
             hb = mb.LeaseHeartbeat(
                 lambda: _connect_restart_store(args, timeout_s=10.0),
                 args.node_rank, spec.epoch,
                 interval_s=max(0.5, args.lease_ttl / 5.0),
                 max_nnodes=args.max_nnodes,
+                # the launcher beats, the WORKERS train: their grad-guard /
+                # async-staleness events ride per-rank beacon files, merged
+                # into one node payload per beat
+                health_source=mb.merged_health_source(beacons),
             ).start()
             tracker = None
             if is_coord:
@@ -673,6 +734,12 @@ def run_elastic(args) -> int:
                     client, spec.epoch,
                     [i for i in spec.ranks if i != args.node_rank],
                     ttl_s=args.lease_ttl,
+                    fence_unhealthy_after=(
+                        _env.get_elastic_fence_unhealthy() or None
+                    ),
+                    # the coordinator can't lease-expire itself, but its
+                    # own workers' health must still reach the fence
+                    observe_only_ids=[args.node_rank],
                 )
             procs = spawn_gang(args, spec)
             try:
@@ -704,6 +771,29 @@ def run_elastic(args) -> int:
                     survivors -= set(s.nodes)
                 expect = survivors | set(s.standby)
                 epoch = spec.epoch + 1
+                if s.kind == mb.STOP_HEALTH and args.node_rank in s.nodes:
+                    # this node was fenced for chronic bad health — exiting
+                    # (instead of waiting as a standby) keeps it from
+                    # bouncing back into the fleet it was just removed from;
+                    # an operator restarts it deliberately after diagnosis
+                    logger.error(
+                        "this node was health-fenced at epoch %d (%s); "
+                        "exiting", spec.epoch, s.reason,
+                    )
+                    if is_coord:
+                        # the membership store lives in this process, so
+                        # fencing the coordinator halts the whole job:
+                        # publish the verdict and give survivors a beat to
+                        # read it before the store dies with us
+                        try:
+                            client.publish_halt(
+                                4,
+                                f"coordinator node health-fenced: {s.reason}",
+                            )
+                            time.sleep(3.0)
+                        except Exception:  # noqa: BLE001 - teardown
+                            pass
+                    return 4
                 if s.kind == mb.STOP_RESIZE:
                     logger.warning(
                         "coordinated resize at epoch %d (%s); regrouping "

@@ -19,6 +19,9 @@ Modules:
 * :mod:`.membership` — lease-based node registry on the existing TCPStore:
   per-node heartbeat thread, TTL leases tracked coordinator-side, and
   epoch-fenced keys so a zombie from attempt N cannot corrupt attempt N+1.
+  Heartbeats also carry a **health payload** (grad-guard / async-staleness
+  event counters via the node's beacon file) the coordinator can fence on
+  (``BAGUA_ELASTIC_FENCE_UNHEALTHY``; see docs/robustness.md).
 * :mod:`.coordinator` — rendezvous rounds: open, admit within the join
   window, decide the world size, assign dense ranks, publish the spec.
 * :mod:`.resize` — worker-side hooks: rebuild the mesh from the
@@ -32,7 +35,12 @@ from .membership import (  # noqa: F401
     LeaseTracker,
     MembershipClient,
     WorldSpec,
+    file_health_source,
+    health_event_count,
+    local_health_snapshot,
+    merged_health_source,
     publish_leave_intent,
+    write_health_beacon,
 )
 from .coordinator import (  # noqa: F401
     ElasticCoordinator,

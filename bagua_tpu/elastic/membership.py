@@ -25,10 +25,13 @@ Key layout (all under the restart store)::
     elastic/halt                 terminal verdict {code, reason} — job over
     elastic/<e>/join/<id>        join request {node_id, host, pid}
     elastic/<e>/world            published WorldSpec (see class below)
-    elastic/<e>/hb/<id>          heartbeat sequence number
+    elastic/<e>/hb/<id>          heartbeat sequence number — either the bare
+                                 integer or ``{"seq": n, "health": {...}}``
+                                 when the node publishes a health payload
+                                 (grad-guard / async-staleness event counts)
     elastic/<e>/stop             first stop event of the attempt
                                  {kind, node, reason}; kinds: fail,
-                                 lease_expired, leave, resize
+                                 lease_expired, leave, resize, health_fenced
     elastic/<e>/leave/<id>       leave intent (deliberate departure —
                                  watchdog exit, SIGINT — vs a silent hang)
     elastic/<e>/done/<id>        clean completion marker
@@ -43,10 +46,11 @@ import socket
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import env as _env
 from ..faults import inject as _inject
+from ..telemetry import counters as _counters
 
 logger = logging.getLogger("bagua_tpu.elastic")
 
@@ -56,6 +60,7 @@ STOP_FAIL = "fail"                    # a worker crashed
 STOP_LEASE_EXPIRED = "lease_expired"  # a node's launcher went silent
 STOP_LEAVE = "leave"                  # deliberate departure (watchdog, ^C)
 STOP_RESIZE = "resize"                # standby joined; regroup at n+standby
+STOP_HEALTH = "health_fenced"         # heartbeat health payload over limit
 
 
 def _k_epoch() -> str:
@@ -168,15 +173,50 @@ class MembershipClient:
 
     # -- heartbeats ---------------------------------------------------------
 
-    def beat(self, epoch: int, seq: int) -> None:
-        self.store.set(_k_hb(epoch, self.node_id), str(int(seq)))
+    def beat(self, epoch: int, seq: int,
+             health: Optional[dict] = None) -> None:
+        """Publish this node's heartbeat.  ``health`` (optional) rides the
+        same key as a JSON payload — the cheapest channel to the
+        coordinator that already exists and already has freshness
+        semantics: a stale health report expires with its lease."""
+        if health is None:
+            payload = str(int(seq))
+        else:
+            payload = json.dumps({"seq": int(seq), "health": health})
+        self.store.set(_k_hb(epoch, self.node_id), payload)
+
+    @staticmethod
+    def _parse_beat(v) -> Tuple[Optional[int], Optional[dict]]:
+        """One heartbeat value -> (seq, health): accepts both the bare
+        integer wire format (pre-health nodes keep working) and the JSON
+        payload; unparseable values read as no-beat rather than crashing
+        the monitor."""
+        if v is None:
+            return None, None
+        try:
+            return int(v), None
+        except (TypeError, ValueError):
+            pass
+        try:
+            d = json.loads(v)
+            return int(d["seq"]), d.get("health")
+        except (TypeError, ValueError, KeyError):
+            logger.warning("unparseable heartbeat value %r ignored", v)
+            return None, None
 
     def read_beats(self, epoch: int, node_ids: List[int]) -> Dict[int, Optional[int]]:
-        vals = self.store.mget([_k_hb(epoch, i) for i in node_ids])
         return {
-            i: (int(v) if v is not None else None)
-            for i, v in zip(node_ids, vals)
+            i: seq
+            for i, (seq, _) in self.read_beats_full(epoch, node_ids).items()
         }
+
+    def read_beats_full(
+        self, epoch: int, node_ids: List[int]
+    ) -> Dict[int, Tuple[Optional[int], Optional[dict]]]:
+        """Heartbeat sequence AND health payload per node (None, None for a
+        node that never beat)."""
+        vals = self.store.mget([_k_hb(epoch, i) for i in node_ids])
+        return {i: self._parse_beat(v) for i, v in zip(node_ids, vals)}
 
     # -- stop / leave / done / halt ----------------------------------------
 
@@ -224,6 +264,105 @@ class MembershipClient:
         return json.loads(v) if v is not None else None
 
 
+# ---- health payload -------------------------------------------------------
+
+#: telemetry counters that ride the heartbeat as the health payload: events
+#: that mark a rank as a liability to the fleet (non-finite gradient steps
+#: from the grad-guard sentinel, async model-average rounds the rank failed
+#: to apply, its current staleness gauge)
+_HEALTH_COUNTERS = {
+    "grad_unhealthy": "grad_guard/unhealthy_steps",
+    "grad_skipped": "grad_guard/skipped_steps",
+    "async_missed": "async/missed_boundaries",
+    "async_staleness": "async/staleness_max",
+}
+
+
+def local_health_snapshot() -> Optional[dict]:
+    """This process's health payload from the telemetry counters — None
+    when every counter is zero, so healthy fleets pay no payload bytes."""
+    snap = {
+        k: _counters.get(name) for k, name in _HEALTH_COUNTERS.items()
+    }
+    snap = {k: v for k, v in snap.items() if v}
+    return snap or None
+
+
+def health_event_count(health: Optional[dict]) -> int:
+    """The scalar the coordinator fences on: how many times this rank hurt
+    the fleet — non-finite-gradient steps plus missed async negotiation
+    rounds (staleness gauges are a symptom, not an event count)."""
+    if not health:
+        return 0
+    return int(health.get("grad_unhealthy", 0)) + int(
+        health.get("async_missed", 0)
+    )
+
+
+def write_health_beacon(path: Optional[str] = None) -> bool:
+    """Publish this process's health snapshot to the beacon file named by
+    ``BAGUA_ELASTIC_HEALTH_FILE`` (launcher-injected) so the LAUNCHER's
+    lease heartbeat — a different process — can carry it to the
+    coordinator.  Atomic (tmp + ``os.replace``) and exception-free: the
+    callers are the trainer's health paths, which must never die on a full
+    disk.  No-op (False) when no beacon path is configured."""
+    p = path or _env.get_elastic_health_file()
+    if not p:
+        return False
+    try:
+        snap = local_health_snapshot() or {}
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(snap, f)
+        os.replace(tmp, p)
+        return True
+    except OSError as e:
+        logger.debug("health beacon not written: %s", e)
+        return False
+
+
+def file_health_source(path: str) -> Callable[[], Optional[dict]]:
+    """Health source reading a worker's beacon file — the launcher side of
+    :func:`write_health_beacon`.  Missing/torn files read as healthy (the
+    beacon only exists once something went wrong)."""
+
+    def read() -> Optional[dict]:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            return data or None
+        except (OSError, ValueError):
+            return None
+
+    return read
+
+
+def merged_health_source(
+    paths: List[str],
+) -> Callable[[], Optional[dict]]:
+    """Health source merging every local worker's beacon into one node
+    payload (the launcher injects one beacon file PER local rank — a file
+    shared across workers would be last-writer-wins, hiding all but one
+    worker's events from the fence).  Event counts sum across workers;
+    staleness gauges take the max."""
+    readers = [file_health_source(p) for p in paths]
+
+    def read() -> Optional[dict]:
+        merged: dict = {}
+        for reader in readers:
+            snap = reader()
+            if not snap:
+                continue
+            for key, val in snap.items():
+                if key == "async_staleness":
+                    merged[key] = max(int(merged.get(key, 0)), int(val))
+                else:
+                    merged[key] = int(merged.get(key, 0)) + int(val)
+        return merged or None
+
+    return read
+
+
 class LeaseHeartbeat:
     """Per-node heartbeat thread: bumps this node's sequence number every
     ``interval_s`` on its OWN store connection (the monitor loop shares the
@@ -231,15 +370,28 @@ class LeaseHeartbeat:
 
     Epoch-fenced: each beat re-reads ``elastic/epoch`` and the thread stops
     itself the moment the coordinator has moved past the epoch it was
-    started for — a zombie cannot keep a stale lease looking alive."""
+    started for — a zombie cannot keep a stale lease looking alive.
+
+    Each beat also carries a **health payload** from ``health_source`` —
+    default: this process's :func:`local_health_snapshot` (grad-guard and
+    async-staleness event counters).  The launcher passes a
+    :func:`file_health_source` reading the worker's beacon file instead.
+    The coordinator's :class:`LeaseTracker` surfaces the payload and can
+    fence chronically unhealthy members through the same stop/resize
+    machinery that handles lease expiry."""
 
     def __init__(self, connect, node_id: int, epoch: int,
-                 interval_s: float = 2.0, max_nnodes: int = 1):
+                 interval_s: float = 2.0, max_nnodes: int = 1,
+                 health_source: Optional[Callable[[], Optional[dict]]] = None):
         self._connect = connect  # () -> store client
         self._node_id = int(node_id)
         self._epoch = int(epoch)
         self._interval_s = float(interval_s)
         self._max_nnodes = int(max_nnodes)
+        self._health_source = (
+            health_source if health_source is not None
+            else local_health_snapshot
+        )
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"bagua-elastic-hb-{node_id}", daemon=True
@@ -272,8 +424,13 @@ class LeaseHeartbeat:
                     # advancing) without killing any process — the
                     # coordinator must expire it and shrink the world
                     continue
+                try:
+                    health = self._health_source()
+                except Exception as e:  # noqa: BLE001 - beats must survive
+                    logger.debug("health source failed: %s", e)
+                    health = None
                 seq += 1
-                client.beat(self._epoch, seq)
+                client.beat(self._epoch, seq, health=health)
             except (ConnectionError, OSError, TimeoutError):
                 client = None  # reconnect on the next tick
 
@@ -293,30 +450,75 @@ class LeaseTracker:
     heartbeat sequence stops advancing for ``ttl_s`` (measured on the
     coordinator's monotonic clock — no cross-host time comparison).  The
     first ``ttl_s`` after construction is a grace period: a member whose
-    first beat is still in flight is not declared dead."""
+    first beat is still in flight is not declared dead.
+
+    Each poll also harvests the members' heartbeat **health payloads**
+    (:meth:`health_of`); with ``fence_unhealthy_after`` set,
+    :meth:`unhealthy_members` names members whose reported event count
+    (:func:`health_event_count`) reached the limit — the monitor converts
+    them into a ``health_fenced`` stop, reusing the exact epoch/resize
+    machinery lease expiry rides.
+
+    ``observe_only_ids`` are polled for health but never lease-expired:
+    the coordinator cannot meaningfully expire its own lease (a dead
+    launcher cannot run the monitor at all), but it CAN read its own
+    heartbeat's health payload — without this the fence has a silent
+    coverage hole on exactly the coordinator node."""
 
     def __init__(self, client: MembershipClient, epoch: int,
-                 member_ids: List[int], ttl_s: float = 10.0):
+                 member_ids: List[int], ttl_s: float = 10.0,
+                 fence_unhealthy_after: Optional[int] = None,
+                 observe_only_ids: Optional[List[int]] = None):
         self._client = client
         self._epoch = int(epoch)
         self._ttl_s = float(ttl_s)
         self._leases = {int(i): _LeaseState() for i in member_ids}
+        self._observe_only = [
+            int(i) for i in (observe_only_ids or ())
+            if int(i) not in self._leases
+        ]
+        self._health: Dict[int, dict] = {}
+        if fence_unhealthy_after is not None and fence_unhealthy_after < 1:
+            fence_unhealthy_after = None
+        self._fence_unhealthy_after = fence_unhealthy_after
 
     def poll(self) -> List[int]:
         """One scan; returns member ids whose lease has expired."""
-        beats = self._client.read_beats(
-            self._epoch, list(self._leases)
+        beats = self._client.read_beats_full(
+            self._epoch, list(self._leases) + self._observe_only
         )
         now = time.monotonic()
+        for node_id in self._observe_only:
+            _seq, health = beats.get(node_id, (None, None))
+            if health is not None:
+                self._health[node_id] = health
         expired = []
         for node_id, lease in self._leases.items():
-            seq = beats.get(node_id)
+            seq, health = beats.get(node_id, (None, None))
+            if health is not None:
+                self._health[node_id] = health
             if seq is not None and seq != lease.seq:
                 lease.seq = seq
                 lease.changed_at = now
             elif now - lease.changed_at > self._ttl_s:
                 expired.append(node_id)
         return expired
+
+    def health_of(self, node_id: int) -> Optional[dict]:
+        """Latest health payload observed for ``node_id`` (None = the node
+        never reported one — healthy nodes publish nothing)."""
+        return self._health.get(int(node_id))
+
+    def unhealthy_members(self) -> List[int]:
+        """Member ids whose reported health event count reached
+        ``fence_unhealthy_after`` (empty when fencing is disabled)."""
+        if self._fence_unhealthy_after is None:
+            return []
+        return [
+            nid for nid in list(self._leases) + self._observe_only
+            if health_event_count(self._health.get(nid))
+            >= self._fence_unhealthy_after
+        ]
 
     def expire_now(self, node_id: int) -> None:
         """Force-expire (test hook / explicit eviction)."""

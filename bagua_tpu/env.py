@@ -91,7 +91,17 @@ _declare("BAGUA_FAULT_PLAN", "str", "",
          "kind, step/op trigger, count, seed) armed at process start — "
          "drills and chaos tests only, never production.  Points: "
          "store.op, elastic.heartbeat, ckpt.write, ckpt.sidecar, "
-         "collective.hang, grad.poison.  See bagua_tpu.faults.inject.")
+         "collective.hang, grad.poison, step.straggle, async.partition.  "
+         "See bagua_tpu.faults.inject.")
+_declare("BAGUA_ASYNC_MAX_STALENESS", "int", "4",
+         "Bounded-staleness cap for async model averaging: when any rank's "
+         "applied-round counter reaches this many rounds behind the "
+         "launched count (grad-guard rewinds or async.partition drops "
+         "stall it), that negotiated boundary forces a synchronous "
+         "catch-up average that leaves every rank's replica bit-identical "
+         "— the lag never exceeds the cap.  0 disables the bound (purely "
+         "asynchronous).  Constructor knob: "
+         "AsyncModelAverageAlgorithm(max_staleness_rounds=).")
 # -- autotune sidecar --
 _declare("BAGUA_SERVICE_PORT", "int", "-1",
          "Port of the autotune hyperparameter service; -1 disables.")
@@ -143,6 +153,19 @@ _declare("BAGUA_ELASTIC_LEASE_TTL_S", "float", "15",
          "Membership lease TTL; an expired lease shrinks the world.")
 _declare("BAGUA_ELASTIC_TELEMETRY_OUT", "str", "",
          "Path where membership counters + transitions are dumped on exit.")
+_declare("BAGUA_ELASTIC_HEALTH_FILE", "str", "",
+         "Path of this worker's health beacon file (launcher-injected, one "
+         "file per local rank): the worker's gradient-guard / "
+         "async-staleness event counters are published here; the launcher "
+         "merges all local beacons and carries them on its lease heartbeat "
+         "to the coordinator as a health payload.")
+_declare("BAGUA_ELASTIC_FENCE_UNHEALTHY", "int", "0",
+         "Coordinator-side health fence: expel a member whose heartbeat "
+         "health payload reports at least this many unhealthy events "
+         "(non-finite-gradient steps, missed async negotiation "
+         "boundaries).  The fenced node's launcher exits instead of "
+         "rejoining; survivors resize through the normal epoch machinery.  "
+         "0 (default) disables fencing.")
 
 
 # ---- typed accessors -----------------------------------------------------
@@ -351,6 +374,11 @@ def get_fault_plan_raw() -> Optional[str]:
     return _raw("BAGUA_FAULT_PLAN")
 
 
+def get_async_max_staleness() -> int:
+    """Bounded-staleness cap for async model averaging (0 = unbounded)."""
+    return env_int("BAGUA_ASYNC_MAX_STALENESS")
+
+
 def get_bagua_service_port() -> int:
     return env_int("BAGUA_SERVICE_PORT")
 
@@ -417,6 +445,17 @@ def get_elastic_lease_ttl_s() -> float:
 
 def get_elastic_telemetry_out() -> Optional[str]:
     return _raw("BAGUA_ELASTIC_TELEMETRY_OUT")
+
+
+def get_elastic_health_file() -> Optional[str]:
+    """This worker's health beacon path (launcher-injected, per local
+    rank); None disables the worker->launcher health channel."""
+    return _raw("BAGUA_ELASTIC_HEALTH_FILE")
+
+
+def get_elastic_fence_unhealthy() -> int:
+    """Health-fence threshold (0 = fencing disabled)."""
+    return env_int("BAGUA_ELASTIC_FENCE_UNHEALTHY")
 
 
 def get_elastic_store_addr() -> Optional[str]:

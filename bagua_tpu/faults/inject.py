@@ -28,6 +28,18 @@ Points and what firing them does:
                         gradient at a chosen step inside the compiled train
                         step — the gradient-health sentinel must detect and
                         (policy permitting) skip it
+``step.straggle``       dilates a chosen rank's step by ``factor``× its base
+                        step time.  The straggler's own process always pays
+                        the dilation; every OTHER process pays it only at a
+                        *gated* synchronization point — a per-step gradient
+                        collective (synchronous families), an async
+                        negotiation boundary, a catch-up sync — which is
+                        exactly where a slow peer binds in a real fleet
+``async.partition``     drops a rank from one async-model-average
+                        negotiation round: the round launched at the fired
+                        boundary is never applied by that rank — the
+                        bounded-staleness tracker must detect the lag and
+                        force a synchronous catch-up average
 ======================  =====================================================
 
 Every armed/fired/recovered event lands in
@@ -46,6 +58,7 @@ import logging
 import os
 import random
 import threading
+import time
 from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
@@ -62,6 +75,8 @@ FAULT_POINTS = (
     "ckpt.sidecar",
     "collective.hang",
     "grad.poison",
+    "step.straggle",
+    "async.partition",
 )
 
 #: default fault kind per point (the only kind most points support)
@@ -72,6 +87,8 @@ _DEFAULT_KINDS = {
     "ckpt.sidecar": "truncate",
     "collective.hang": "hang",
     "grad.poison": "nan",
+    "step.straggle": "dilate",
+    "async.partition": "drop",
 }
 
 _VALID_KINDS = {
@@ -81,6 +98,8 @@ _VALID_KINDS = {
     "ckpt.sidecar": ("truncate", "corrupt"),
     "collective.hang": ("hang",),
     "grad.poison": ("nan", "inf"),
+    "step.straggle": ("dilate",),
+    "async.partition": ("drop",),
 }
 
 
@@ -112,6 +131,10 @@ class FaultSpec:
     seed: int = 0
     bucket: int = 0          # grad.poison: target bucket index
     duration_s: float = 30.0  # collective.hang: how long to wedge
+    rank: int = 0            # step.straggle: which process rank is slow
+    factor: float = 10.0     # step.straggle: dilation multiple of base time
+    base_ms: float = 0.0     # step.straggle: straggler base step time; 0 =
+    #                          use the caller-measured step time instead
 
     def __post_init__(self):
         if self.point not in FAULT_POINTS:
@@ -124,6 +147,10 @@ class FaultSpec:
             raise ValueError(
                 f"fault kind {kind!r} invalid for {self.point!r}; valid: "
                 f"{_VALID_KINDS[self.point]}"
+            )
+        if self.point == "step.straggle" and self.factor < 1.0:
+            raise ValueError(
+                f"step.straggle factor must be >= 1.0, got {self.factor}"
             )
 
     def signature(self) -> tuple:
@@ -354,8 +381,6 @@ def maybe_hang(stop_event: Optional[threading.Event] = None) -> float:
     if stop_event is not None:
         stop_event.wait(spec.duration_s)
     else:  # pragma: no cover - all in-repo callers pass their stop event
-        import time
-
         time.sleep(spec.duration_s)
     return spec.duration_s
 
@@ -410,6 +435,59 @@ def maybe_corrupt_checkpoint(directory, step: int) -> bool:
         f.write(bytes(data))
     logger.warning("ckpt.write injection: flipped %d bytes in %s", n, target)
     return True
+
+
+def maybe_straggle(sync_point: str, base_dt: Optional[float] = None,
+                   gated: bool = True) -> float:
+    """``step.straggle`` hook: stall the caller by ``(factor - 1)``× the
+    straggler's base step time, simulating a slow host in the fleet.
+
+    ``gated`` names whether the calling code path actually synchronizes
+    with the straggler: a per-step gradient collective (synchronous
+    families) or an async negotiation/catch-up boundary is gated; an async
+    train step running on stale local weights is not.  The straggler's OWN
+    process (``spec.rank == env.get_rank()``) always pays the dilation —
+    its host really is slow — while peers pay only at gated points, which
+    is where a slow peer binds in a real fleet.  Returns seconds slept
+    (0 = no fault, or the straggler does not gate this point)."""
+    plan = get_plan()
+    if plan is None:
+        return 0.0
+    specs = plan.armed_specs("step.straggle")
+    if not specs:
+        return 0.0
+    this_rank = _env.get_rank()
+    if not any(s.rank == this_rank or gated for s in specs):
+        return 0.0
+    if not base_dt and not any(s.base_ms > 0 for s in specs):
+        # no dilation base exists yet (the caller has not measured a step
+        # cadence and no spec pins base_ms): a fire here would be spent on
+        # a zero-length sleep while still counting as "fired" — skip the
+        # query so a count-limited spec waits for a base instead
+        logger.warning("step.straggle: no base step time at %s — "
+                       "fire not consumed", sync_point)
+        return 0.0
+    spec = plan.should_fire("step.straggle")
+    if spec is None:
+        return 0.0
+    base = spec.base_ms / 1000.0 if spec.base_ms > 0 else float(base_dt or 0)
+    delay = max(0.0, (spec.factor - 1.0) * base)
+    if delay > 0.0:
+        logger.debug("step.straggle: stalling %s for %.4fs (factor %.1f)",
+                     sync_point, delay, spec.factor)
+        time.sleep(delay)
+    return delay
+
+
+def maybe_drop_negotiation_round() -> bool:
+    """``async.partition`` hook (async model average's negotiated
+    boundary): True = this rank is partitioned out of the round launched
+    at this boundary — it still participates in the negotiation gather and
+    the averaging collective (the SPMD dispatch schedule must stay aligned
+    on every process), but it never APPLIES the round's delta, so its
+    applied-round counter stalls and the bounded-staleness tracker must
+    catch it."""
+    return should_fire("async.partition") is not None
 
 
 def maybe_corrupt_sidecar(path, step: int) -> bool:

@@ -23,6 +23,15 @@ deterministically:
 5. **collective hang → watchdog abort + reset recovery**: the waiter's
    readback wedges; the monitor fires, raises the abort flag, and after
    ``reset_abort`` training resumes — twice, proving re-arming.
+6. **10× straggler → degraded but alive**: a ``step.straggle`` peer dilates
+   every synchronous step; throughput degrades by roughly the dilation
+   factor yet every step completes with a finite loss — and the async
+   family under the SAME fault retains most of its throughput (it gates on
+   the straggler only at negotiated boundaries).
+7. **async partition → bounded-staleness catch-up**: ``async.partition``
+   drops every negotiation round; the applied-round counter stalls, the
+   staleness tracker catches it at the cap, and the forced synchronous
+   catch-up re-syncs the replicas bit-identically while training continues.
 
 Writes ``CHAOS_DRILL.json`` (schema-gated in ``tests/test_bench_sanity.py``);
 exit code 0 iff every fault was detected AND recovered.
@@ -331,6 +340,135 @@ def drill_collective_hang():
                        f"({episodes})"}
 
 
+def _golden_trainer(algo, **kw):
+    import bench
+    import optax
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.parallel.mesh import build_mesh
+
+    loss_fn, params, batch = bench.golden_task()
+    t = BaguaTrainer(loss_fn, optax.sgd(0.1), algo,
+                     mesh=build_mesh({"dp": 8}), autotune=False, **kw)
+    s = t.init(params)
+    return t, s, t.shard_batch(batch)
+
+
+def drill_straggler_throughput():
+    """A 10× peer straggler gates every synchronous step: throughput
+    degrades by roughly the dilation yet every step completes — while the
+    async family under the SAME armed fault keeps its steps ungated and
+    pays only at negotiated boundaries (the BENCH_STRAGGLER measurement
+    in miniature)."""
+    from bagua_tpu.algorithms import (
+        AsyncModelAverageAlgorithm,
+        GradientAllReduceAlgorithm,
+    )
+
+    base_ms, factor, steps = 10.0, 10.0, 12
+
+    def timed_run(algo):
+        t, s, b = _golden_trainer(algo)
+        s, loss = t.train_step(s, b)  # compile outside the timer
+        float(loss)
+        t0 = time.time()
+        n_finite = 0
+        for _ in range(steps):
+            s, loss = t.train_step(s, b)
+            n_finite += bool(np.isfinite(float(loss)))
+        dt = time.time() - t0
+        if hasattr(algo, "barrier"):
+            s = algo.barrier(t, s)
+        return dt, n_finite
+
+    before = telemetry.counters.snapshot()
+    clean_dt, _ = timed_run(GradientAllReduceAlgorithm())
+    with fault_scope(FaultSpec("step.straggle", rank=1, count=-1,
+                               base_ms=base_ms, factor=factor)):
+        sync_dt, sync_ok = timed_run(GradientAllReduceAlgorithm())
+        async_dt, async_ok = timed_run(
+            AsyncModelAverageAlgorithm(warmup_steps=0, period_steps=4)
+        )
+        deltas = _counter_deltas(before)
+        stall = (factor - 1.0) * base_ms / 1000.0
+        detected = (
+            deltas.get("faults/step.straggle/fired", 0) >= steps
+            and sync_dt >= clean_dt + steps * stall * 0.9  # dilation landed
+        )
+        # alive-under-degradation IS the recovery: every step completed
+        # with a finite loss, and the async family dodged the per-step
+        # gating.  Recorded INSIDE the scope — record_recovery is a no-op
+        # once the plan is disarmed.
+        recovered = (
+            sync_ok == steps and async_ok == steps and async_dt < sync_dt
+        )
+        if detected and recovered:
+            inject.record_recovery("step.straggle")
+    return {"injected": True, "detected": bool(detected),
+            "recovered": bool(recovered),
+            "details": f"{steps} steps: clean {clean_dt:.2f}s, sync+straggle "
+                       f"{sync_dt:.2f}s (all finite: {sync_ok == steps}), "
+                       f"async+straggle {async_dt:.2f}s — async retained "
+                       f"{sync_dt / async_dt:.1f}x sync throughput"}
+
+
+def drill_async_partition_catchup():
+    """Persistent ``async.partition`` drops: the applied-round counter
+    stalls, the negotiated gather sees the lag hit ``max_staleness_rounds``
+    and forces a synchronous catch-up average — replicas bit-identical at
+    the sync point, training continues, telemetry records the round trip."""
+    import jax
+
+    from bagua_tpu.algorithms import AsyncModelAverageAlgorithm
+
+    cap = 2
+    algo = AsyncModelAverageAlgorithm(warmup_steps=2, period_steps=2,
+                                      max_staleness_rounds=cap)
+    t, s, b = _golden_trainer(algo)
+
+    synced_rows_ok = []
+    orig = algo._catchup_sync
+
+    def spy(tr, state, watchdog, step, reason):
+        out = orig(tr, state, watchdog, step, reason)
+        rows = [np.asarray(x) for x in jax.tree.leaves(out.params)]
+        synced_rows_ok.append(all(
+            np.array_equal(a[0], a[r])
+            for a in rows for r in range(1, a.shape[0])
+        ))
+        return out
+
+    algo._catchup_sync = spy
+    before = telemetry.counters.snapshot()
+    lags = []
+    with fault_scope(FaultSpec("async.partition", count=-1)):
+        loss = None
+        for _ in range(20):
+            s, loss = t.train_step(s, b)
+            lags.append(algo._rounds_launched - algo._rounds_applied)
+    s = algo.barrier(t, s)
+    deltas = _counter_deltas(before)
+    detected = (
+        deltas.get("faults/async.partition/fired", 0) >= 1
+        and deltas.get("async/missed_boundaries", 0) >= 1
+        and deltas.get("async/catchup_syncs", 0) >= 1
+    )
+    recovered = (
+        deltas.get("faults/async.partition/recovered", 0) >= 1
+        and max(lags) <= cap                 # the bounded-staleness invariant
+        and bool(synced_rows_ok) and all(synced_rows_ok)
+        and np.isfinite(float(loss))
+        and deltas.get("async/rounds_launched", 0)
+        >= deltas.get("async/catchup_syncs", 0)
+    )
+    return {"injected": True, "detected": bool(detected),
+            "recovered": bool(recovered),
+            "details": f"{deltas.get('async/rounds_dropped', 0)} rounds "
+                       f"dropped, {deltas.get('async/catchup_syncs', 0)} "
+                       f"catch-up sync(s), max lag {max(lags)} <= cap {cap}, "
+                       f"replicas bit-identical at every sync point: "
+                       f"{all(synced_rows_ok)}"}
+
+
 def main():
     import tempfile
 
@@ -345,6 +483,8 @@ def main():
         "nan_grad_skip_loss_continuity": drill_nan_grad_skip,
         "grad_guard_on_goldens_unchanged": drill_guard_on_goldens,
         "collective_hang_watchdog_recovery": drill_collective_hang,
+        "straggler_throughput_degrades": drill_straggler_throughput,
+        "async_partition_staleness_catchup": drill_async_partition_catchup,
     }
     results = {}
     for name, fn in drills.items():

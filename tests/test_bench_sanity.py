@@ -129,6 +129,8 @@ def test_chaos_drill_artifact_schema():
         "nan_grad_skip_loss_continuity",
         "grad_guard_on_goldens_unchanged",
         "collective_hang_watchdog_recovery",
+        "straggler_throughput_degrades",
+        "async_partition_staleness_catchup",
     }
     assert required <= set(record["faults"]), sorted(record["faults"])
     for name, fault in record["faults"].items():
@@ -139,6 +141,43 @@ def test_chaos_drill_artifact_schema():
     assert record["pass"] is True
     counters = record["counters"]
     for point in ("store.op", "elastic.heartbeat", "ckpt.write",
-                  "grad.poison", "collective.hang"):
+                  "grad.poison", "collective.hang", "step.straggle",
+                  "async.partition"):
         assert counters.get(f"faults/{point}/fired", 0) >= 1, point
         assert counters.get(f"faults/{point}/recovered", 0) >= 1, point
+    # the async robustness trail (ISSUE 6): rounds launched, partition
+    # drops surfaced as missed boundaries, and the forced catch-up syncs
+    for key in ("async/rounds_launched", "async/rounds_dropped",
+                "async/missed_boundaries", "async/catchup_syncs"):
+        assert counters.get(key, 0) >= 1, key
+
+
+def test_straggler_bench_artifact_schema():
+    """BENCH_STRAGGLER.json (driver-visible artifact of
+    benchmarks/straggler_bench.py): under the seeded 10× single-rank
+    straggler, async model averaging must retain >= 1.5x the throughput of
+    synchronous allreduce on the 8-dev cpu-sim mesh — per-trial ratios and
+    the noise_bound flag recorded per _ab.py conventions (regenerate with
+    `python benchmarks/straggler_bench.py`)."""
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "BENCH_STRAGGLER.json")
+    assert os.path.exists(path), "run benchmarks/straggler_bench.py first"
+    records = json.load(open(path))
+    by_metric = {r["metric"]: r for r in records}
+
+    headline = by_metric["straggler_async_over_sync_throughput"]
+    assert headline["value"] >= 1.5, headline
+    assert headline["noise_bound"] is False, headline
+    assert len(headline["per_trial_ratios"]) >= 3
+    assert min(headline["per_trial_ratios"]) >= 1.5, headline
+    assert headline["straggler"]["factor"] == 10.0
+    # both sides measured under the SAME armed fault
+    for side in ("straggler_sync_allreduce_straggled_steps_per_sec",
+                 "straggler_async_straggled_steps_per_sec"):
+        assert by_metric[side]["straggler"]["factor"] == 10.0, side
+    # the clean pair attributes the ratio to the fault, not to a baseline
+    # throughput gap between the families
+    assert "straggler_clean_async_over_sync" in by_metric

@@ -565,3 +565,216 @@ def test_default_poison_count_bounds_traced_fires():
     assert _delta(before, "grad_guard/skipped_steps") == 1
     assert _delta(before, "faults/grad.poison/fired") == 1
     assert np.isfinite(float(loss))
+
+
+# ---- step.straggle / async.partition (ISSUE 6) ----------------------------
+
+
+def test_straggle_spec_validation():
+    with pytest.raises(ValueError, match="factor must be >= 1.0"):
+        FaultSpec("step.straggle", factor=0.5)
+    assert FaultSpec("step.straggle").kind == "dilate"
+    assert FaultSpec("async.partition").kind == "drop"
+
+
+def test_maybe_straggle_gating_and_delay():
+    """The straggler's own process always pays; peers pay only at GATED
+    sync points — and an ungated query must not consume a fire."""
+    before = telemetry.counters.snapshot()
+    # straggler is a PEER (rank 1 ≠ this process's rank 0)
+    with fault_scope(FaultSpec("step.straggle", rank=1, count=-1,
+                               base_ms=20.0, factor=3.0)):
+        # ungated point (async train step): no stall, no fire consumed
+        assert inject.maybe_straggle("step", gated=False) == 0.0
+        assert _delta(before, "faults/step.straggle/fired") == 0
+        # gated point: stall for (factor-1) * base
+        t0 = time.monotonic()
+        delay = inject.maybe_straggle("collective", gated=True)
+        assert abs(delay - 0.04) < 1e-9
+        assert time.monotonic() - t0 >= 0.04
+        assert _delta(before, "faults/step.straggle/fired") == 1
+    # straggler is THIS process: pays even at ungated points
+    with fault_scope(FaultSpec("step.straggle", rank=0, count=-1,
+                               base_ms=10.0, factor=2.0)):
+        assert inject.maybe_straggle("step", gated=False) > 0.0
+
+
+def test_maybe_straggle_uses_caller_base_dt():
+    """base_ms=0 (default) falls back to the caller-measured step time."""
+    with fault_scope(FaultSpec("step.straggle", count=-1, factor=2.0)):
+        assert abs(inject.maybe_straggle("step", base_dt=0.015) - 0.015) < 1e-9
+        assert inject.maybe_straggle("step", base_dt=None) == 0.0
+
+
+def test_straggle_dilates_sync_family_steps():
+    """An armed 10× peer straggler dilates a synchronous family's steps
+    (the per-step gradient collective gates on the slow peer)."""
+    t, s, b, _ = _make_trainer(n_steps=3)  # warm: compile + cadence sample
+    t0 = time.monotonic()
+    for _ in range(3):
+        s, _ = t.train_step(s, b)
+    clean = time.monotonic() - t0
+    assert t.measured_step_dt() is not None
+    before = telemetry.counters.snapshot()
+    with fault_scope(FaultSpec("step.straggle", rank=1, count=-1,
+                               base_ms=20.0, factor=3.0)):
+        t0 = time.monotonic()
+        for _ in range(3):
+            s, loss = t.train_step(s, b)
+        straggled = time.monotonic() - t0
+    assert _delta(before, "faults/step.straggle/fired") == 3
+    assert straggled >= clean + 3 * 0.04 * 0.9  # 3 stalls of (3-1)*20ms
+    assert np.isfinite(float(loss))
+
+
+def test_partition_hook_consumes_one_round():
+    plan = FaultPlan([FaultSpec("async.partition")])
+    inject.set_plan(plan)
+    assert inject.maybe_drop_negotiation_round() is True
+    assert inject.maybe_drop_negotiation_round() is False  # count=1 spent
+
+
+# ---- heartbeat health payload / fencing (ISSUE 6) -------------------------
+
+
+def test_parse_beat_wire_compat():
+    from bagua_tpu.elastic.membership import MembershipClient
+
+    assert MembershipClient._parse_beat(None) == (None, None)
+    assert MembershipClient._parse_beat("7") == (7, None)
+    assert MembershipClient._parse_beat(b"7") == (7, None)
+    seq, health = MembershipClient._parse_beat(
+        '{"seq": 3, "health": {"grad_unhealthy": 2}}'
+    )
+    assert seq == 3 and health == {"grad_unhealthy": 2}
+    assert MembershipClient._parse_beat("{torn json") == (None, None)
+
+
+def test_beat_carries_health_payload():
+    from bagua_tpu.contrib.utils.store import InMemoryStore
+    from bagua_tpu.elastic.membership import MembershipClient
+
+    client = MembershipClient(InMemoryStore(), node_id=3, max_nnodes=4)
+    client.beat(0, 1)
+    assert client.read_beats_full(0, [3])[3] == (1, None)
+    client.beat(0, 2, health={"async_missed": 5})
+    assert client.read_beats_full(0, [3])[3] == (2, {"async_missed": 5})
+    # legacy reader still sees plain sequence numbers
+    assert client.read_beats(0, [3]) == {3: 2}
+
+
+def test_health_event_count_semantics():
+    from bagua_tpu.elastic.membership import health_event_count
+
+    assert health_event_count(None) == 0
+    assert health_event_count({"async_staleness": 9}) == 0  # gauge ≠ event
+    assert health_event_count(
+        {"grad_unhealthy": 2, "async_missed": 3, "async_staleness": 9}
+    ) == 5
+
+
+def test_health_beacon_roundtrip(tmp_path, monkeypatch):
+    from bagua_tpu.elastic.membership import (
+        file_health_source,
+        write_health_beacon,
+    )
+
+    # no beacon path configured -> no-op
+    monkeypatch.delenv("BAGUA_ELASTIC_HEALTH_FILE", raising=False)
+    assert write_health_beacon() is False
+    path = str(tmp_path / "health.json")
+    monkeypatch.setenv("BAGUA_ELASTIC_HEALTH_FILE", path)
+    telemetry.counters.incr("grad_guard/unhealthy_steps")
+    assert write_health_beacon() is True
+    snap = file_health_source(path)()
+    assert snap and snap.get("grad_unhealthy", 0) >= 1
+    # missing/torn files read as healthy
+    assert file_health_source(str(tmp_path / "nope.json"))() is None
+    with open(path, "w") as f:
+        f.write("{torn")
+    assert file_health_source(path)() is None
+
+
+def test_lease_tracker_fences_unhealthy_member():
+    """The coordinator-side fence: a member whose heartbeat health payload
+    reports enough events is named by unhealthy_members() — the monitor
+    turns that into a health_fenced stop through the resize machinery."""
+    from bagua_tpu.contrib.utils.store import InMemoryStore
+    from bagua_tpu.elastic.membership import LeaseTracker, MembershipClient
+
+    store = InMemoryStore()
+    client = MembershipClient(store, node_id=0, max_nnodes=2)
+    tracker = LeaseTracker(client, epoch=0, member_ids=[0, 1], ttl_s=30.0,
+                           fence_unhealthy_after=3)
+    client.beat(0, 1)
+    MembershipClient(store, node_id=1, max_nnodes=2).beat(
+        0, 1, health={"grad_unhealthy": 2, "async_missed": 1}
+    )
+    assert tracker.poll() == []  # nobody's lease expired
+    assert tracker.health_of(0) is None
+    assert tracker.health_of(1) == {"grad_unhealthy": 2, "async_missed": 1}
+    assert tracker.unhealthy_members() == [1]
+    # fencing disabled -> never named
+    relaxed = LeaseTracker(client, epoch=0, member_ids=[0, 1], ttl_s=30.0)
+    relaxed.poll()
+    assert relaxed.unhealthy_members() == []
+
+
+def test_grad_guard_publishes_health_beacon(tmp_path, monkeypatch):
+    """The trainer's unhealthy-step path publishes the beacon file the
+    launcher's heartbeat reads — the worker->coordinator health channel."""
+    path = str(tmp_path / "beacon.json")
+    monkeypatch.setenv("BAGUA_ELASTIC_HEALTH_FILE", path)
+    with fault_scope(FaultSpec("grad.poison", step=1)):
+        t, s, b, loss = _make_trainer("skip", n_steps=3)
+    assert os.path.exists(path)
+    from bagua_tpu.elastic.membership import file_health_source
+
+    snap = file_health_source(path)()
+    assert snap and snap.get("grad_skipped", 0) >= 1
+
+
+def test_merged_health_source_sums_events_max_gauges(tmp_path):
+    """The launcher merges one beacon per local rank into a node payload:
+    event counts sum across workers, staleness gauges take the max, and
+    missing files read as healthy (last-writer-wins on a shared file would
+    hide all but one worker's events from the fence)."""
+    import json
+
+    from bagua_tpu.elastic.membership import merged_health_source
+
+    paths = [str(tmp_path / f"beacon.r{i}") for i in range(3)]
+    src = merged_health_source(paths)
+    assert src() is None  # no beacons yet -> healthy
+    with open(paths[0], "w") as f:
+        json.dump({"grad_unhealthy": 2, "async_staleness": 3}, f)
+    with open(paths[1], "w") as f:
+        json.dump({"grad_unhealthy": 1, "async_missed": 4,
+                   "async_staleness": 1}, f)
+    # paths[2] never written: that worker is healthy
+    assert src() == {"grad_unhealthy": 3, "async_missed": 4,
+                     "async_staleness": 3}
+
+
+def test_lease_tracker_observes_coordinator_health():
+    """observe_only_ids closes the fence's coverage hole on the
+    coordinator node: its health payload is harvested and fenceable, but
+    it can never lease-expire (a dead launcher cannot run the monitor)."""
+    import time as _time
+
+    from bagua_tpu.contrib.utils.store import InMemoryStore
+    from bagua_tpu.elastic.membership import LeaseTracker, MembershipClient
+
+    store = InMemoryStore()
+    client = MembershipClient(store, node_id=0, max_nnodes=2)
+    tracker = LeaseTracker(client, epoch=0, member_ids=[1], ttl_s=0.1,
+                           fence_unhealthy_after=3,
+                           observe_only_ids=[0])
+    MembershipClient(store, node_id=1, max_nnodes=2).beat(0, 1)
+    client.beat(0, 1, health={"grad_unhealthy": 5})  # own workers sick
+    assert tracker.poll() == []
+    assert tracker.health_of(0) == {"grad_unhealthy": 5}
+    assert tracker.unhealthy_members() == [0]
+    # both nodes stop beating: only the lease-tracked member expires
+    _time.sleep(0.25)
+    assert tracker.poll() == [1]
