@@ -63,6 +63,7 @@ from jax.sharding import PartitionSpec as P
 from .. import env
 from ..communication import ReduceOp
 from ..faults import inject as _inject
+from ..obs.spans import trace_span
 from ..telemetry import counters
 from .base import Algorithm, AlgorithmContext
 
@@ -391,7 +392,9 @@ class AsyncModelAverageAlgorithm(Algorithm):
             watchdog.watch("async-catchup") if watchdog is not None
             else nullcontext()
         )
-        with guard:
+        with trace_span("async/catchup", step=step, reason=reason,
+                        launched=self._rounds_launched,
+                        applied=self._rounds_applied), guard:
             avg = self._avg_fn(state.params)
             jax.block_until_ready(avg)
         state = state._replace(params=avg)
@@ -530,9 +533,14 @@ class AsyncModelAverageAlgorithm(Algorithm):
             applied_after = self._rounds_applied + (
                 1 if (self._pending is not None and not will_drop) else 0
             )
-            gathered = _negotiate(
-                [float(my_req), float(applied_after)], watchdog
-            )
+            # span: the negotiation gather is where a slow peer gates every
+            # rank — its duration IS the straggler wait
+            with trace_span("async/negotiate", step=step,
+                            launched=self._rounds_launched,
+                            applied=self._rounds_applied):
+                gathered = _negotiate(
+                    [float(my_req), float(applied_after)], watchdog
+                )
             req = float(np.max(gathered[:, 0]))
             min_applied = int(np.min(gathered[:, 1]))
             if req >= _REQ_ABORT:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, Iterable, Iterator, List, Optional, Set
 
@@ -406,6 +407,79 @@ class PerStepReflatten(Rule):
                 )
 
 
+class UnregisteredCounter(Rule):
+    """Telemetry counter/gauge names must be declared in
+    ``bagua_tpu.obs.export.METRIC_REGISTRY``.
+
+    Checks ``<...>counters.incr/set_gauge`` call sites (plus literal-keyed
+    ``incr_many`` dicts).  Literal names are matched exactly; f-string
+    names (``f"faults/{point}/fired"``) are matched as a pattern — some
+    registered name must fit the template; non-literal names are skipped
+    (unresolvable statically).  The registry import is lazy and
+    import-light (no jax), so the engine still runs without a device."""
+
+    _METHODS = ("incr", "set_gauge", "incr_many")
+
+    @staticmethod
+    def _is_counters_call(node: ast.Call) -> bool:
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in UnregisteredCounter._METHODS):
+            return False
+        recv = _dotted(f.value)
+        return bool(recv) and (recv == "counters"
+                               or recv.endswith(".counters")
+                               or recv.endswith("_counters"))
+
+    @staticmethod
+    def _name_exprs(node: ast.Call):
+        if not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "incr_many":
+            if isinstance(arg, ast.Dict):
+                for key in arg.keys:
+                    if key is not None:
+                        yield key
+            return
+        yield arg
+
+    def _check_name(self, expr: ast.AST):
+        """(metric-name-or-pattern, unregistered?) — None to skip."""
+        from ..obs.export import any_registered_matches, is_registered
+
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value, not is_registered(expr.value)
+        if isinstance(expr, ast.JoinedStr):
+            parts: List[str] = []
+            for v in expr.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(re.escape(str(v.value)))
+                else:  # FormattedValue: any non-empty fragment
+                    parts.append(".+")
+            pattern = "".join(parts)
+            return pattern, not any_registered_matches(pattern)
+        return None
+
+    def visit(self, info: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call)
+                    and self._is_counters_call(node)):
+                continue
+            for expr in self._name_exprs(node):
+                checked = self._check_name(expr)
+                if checked is None:
+                    continue
+                name, unregistered = checked
+                if unregistered:
+                    yield info.finding(
+                        self, node,
+                        f"counter name {name!r} is not declared in "
+                        "obs.export.METRIC_REGISTRY",
+                    )
+
+
 class TorchImport(Rule):
     """No torch imports in the TPU package (ci.sh's historical gate)."""
 
@@ -486,6 +560,18 @@ RULES: List[Rule] = [
              "(`flat_resident=`/ctx.bucket_flats) instead of re-packing "
              "per step; for optimizers, let the trainer unwrap "
              "`fuse_optimizer` onto the resident flats",
+    ),
+    UnregisteredCounter(
+        id="unregistered-counter",
+        summary="`counters.incr`/`set_gauge` with a name not declared in "
+                "obs.export.METRIC_REGISTRY",
+        rationale="A typo'd metric name silently forks a counter nobody "
+                  "reads (the drill gates and the fleet fence then count "
+                  "against the wrong key); the registry is the single "
+                  "source of truth for metric names, kinds, and docs — "
+                  "the counter analog of env.ENV_REGISTRY.",
+        hint="declare the name in bagua_tpu.obs.export.METRIC_REGISTRY "
+             "(kind + doc) or fix the spelling to a registered name",
     ),
     TorchImport(
         id="torch-import",

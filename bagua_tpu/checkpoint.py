@@ -155,9 +155,13 @@ class BaguaCheckpointManager:
         DURABLE: orbax finalizes the previous async save before starting a
         new one, so the pending sidecar flushes at the next :meth:`save`,
         or in :meth:`wait`/:meth:`close` — never ahead of its checkpoint."""
-        saved = self._mgr.save(
-            int(step), args=self._ocp.args.StandardSave(state)
-        )
+        from .obs.spans import trace_span
+
+        with trace_span("ckpt/save", step=int(step),
+                        async_save=self._async_save):
+            saved = self._mgr.save(
+                int(step), args=self._ocp.args.StandardSave(state)
+            )
         if saved:
             # orbax finalizes the PREVIOUS async save inside a proceeding
             # _mgr.save() (its internal wait_until_finished runs after the
@@ -488,6 +492,19 @@ class BaguaCheckpointManager:
         expect_metadata: Optional[dict],
         mesh: Optional[Any],
     ) -> Tuple[int, Any]:
+        from .obs.spans import trace_span
+
+        with trace_span("ckpt/restore", ckpt_step=int(step)):
+            return self._restore_step_inner(step, state_like,
+                                            expect_metadata, mesh)
+
+    def _restore_step_inner(
+        self,
+        step: int,
+        state_like: Any,
+        expect_metadata: Optional[dict],
+        mesh: Optional[Any],
+    ) -> Tuple[int, Any]:
         from jax.sharding import NamedSharding, PartitionSpec
 
         if mesh is None:
@@ -545,10 +562,13 @@ class BaguaCheckpointManager:
         """Compare the restored state's content digest against the one
         recorded at save time (no-op for checkpoints saved without one, or
         when the manager opted out of integrity)."""
+        from .obs.spans import trace_span
+
         recorded = (sidecar or {}).get("integrity")
         if not self._integrity or not recorded:
             return
-        actual = compute_state_digest(restored)
+        with trace_span("ckpt/verify", ckpt_step=int(step)):
+            actual = compute_state_digest(restored)
         if actual is None:  # multi-process partial view: cannot verify
             logger.info("checkpoint integrity: step %d not verifiable on "
                         "this process (non-addressable state)", step)

@@ -32,6 +32,8 @@ from .. import env
 from ..algorithms.base import Algorithm, AlgorithmContext
 from ..bucket import BucketPlan, split_bucket_by_bucket_size
 from ..communication import BaguaCommunicator, ReduceOp, collapse_trivial_axes
+from ..obs import spans as _obs_spans
+from ..obs.spans import trace_span
 from ..parallel.mesh import build_mesh, hierarchical_mesh, mesh_axis_size
 from ..tensor import build_params, _name_of_path
 from ..utils import StatisticalAverage
@@ -472,6 +474,17 @@ class BaguaTrainer:
         from ..profiling import StepProfiler
 
         self._profiler = StepProfiler.from_env()
+        # observability plane (docs/observability.md): resolved once — the
+        # per-step hooks below gate on this flag so BAGUA_OBS=off restores
+        # the exact pre-obs host behavior
+        self._obs_enabled = _obs_spans.enabled()
+        self._last_beacon_write = 0.0
+        if self._obs_enabled:
+            from ..obs import export as _obs_export
+            from ..obs import recorder as _obs_recorder
+
+            _obs_export.maybe_start_global_exporter(self)
+            _obs_recorder.maybe_install_signal_hook()
         self._speed_tracker = StatisticalAverage()
         self._last_report_time = time.time()
         self._last_speed_time = time.time()
@@ -1246,22 +1259,36 @@ class BaguaTrainer:
                 # bucket (readiness) order on exactly that bucket's
                 # finalized gradient — the algorithm families plug in via
                 # reduce_bucket_grad (allreduce, bytegrad's codec pipeline,
-                # ZeRO's reduce-scatter all ride the same machinery)
+                # ZeRO's reduce-scatter all ride the same machinery).
+                # The spans here run at TRACE time (host-side only — the
+                # jaxpr is unchanged) and record the launch ORDER and byte
+                # accounting of the streamed schedule.
                 if self._flat_resident:
                     # flat-resident grads are already the bucket flats
-                    reduced = [algo.reduce_bucket_grad(ctx, i, f)
-                               for i, f in enumerate(grads["flats"])]
+                    reduced = []
+                    for i, f in enumerate(grads["flats"]):
+                        b = plan.buckets[i]
+                        with trace_span(
+                            "trace/bucket_collective", bucket=i,
+                            bytes=int(b.padded_numel
+                                      * np.dtype(b.dtype).itemsize),
+                        ):
+                            reduced.append(algo.reduce_bucket_grad(ctx, i, f))
                     grads, algo_state = algo.grads_from_reduced(
                         ctx, reduced, grads, algo_state, step
                     )
                 else:
-                    grads, algo_state = algo.process_grads_bucketed(
+                    with trace_span("trace/comm_stage", overlap=True,
+                                    buckets=len(plan.buckets)):
+                        grads, algo_state = algo.process_grads_bucketed(
+                            ctx, grads, params, algo_state, step
+                        )
+            else:
+                with trace_span("trace/comm_stage", overlap=False,
+                                buckets=len(plan.buckets)):
+                    grads, algo_state = algo.process_grads(
                         ctx, grads, params, algo_state, step
                     )
-            else:
-                grads, algo_state = algo.process_grads(
-                    ctx, grads, params, algo_state, step
-                )
             if expert is not None:
                 # Expert grads bypass the bucket plan.  The all_to_all
                 # backward already SUMS every ep shard's loss contribution
@@ -1302,13 +1329,16 @@ class BaguaTrainer:
                 # collective launched
                 health_vec = self._grad_health_vec(plan, grads)
             params, algo_state = algo.process_pre_step(ctx, params, algo_state, step)
-            if algo.owns_optimizer:
-                params, opt_state, algo_state = algo.optimizer_update(
-                    ctx, params, grads, opt_state, algo_state, step
-                )
-            else:
-                updates, opt_state = self._opt.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+            with trace_span("trace/optimizer_apply",
+                            owned=algo.owns_optimizer):
+                if algo.owns_optimizer:
+                    params, opt_state, algo_state = algo.optimizer_update(
+                        ctx, params, grads, opt_state, algo_state, step
+                    )
+                else:
+                    updates, opt_state = self._opt.update(grads, opt_state,
+                                                          params)
+                    params = optax.apply_updates(params, updates)
             params, algo_state = algo.process_post_step(ctx, params, algo_state, step)
             if guard != "off" and not replicated_health:
                 # families whose post-comm gradient representation is not
@@ -1456,7 +1486,10 @@ class BaguaTrainer:
         if key not in self._step_cache:
             logger.info("bagua_tpu: compiling train step (phase=%s, %d buckets)",
                         self._phase, len(self._plan.buckets))
-            self._step_cache[key] = self._make_step_fn(self._plan)
+            with trace_span("step/build", phase=self._phase,
+                            buckets=len(self._plan.buckets),
+                            overlap=overlap):
+                self._step_cache[key] = self._make_step_fn(self._plan)
             # the step that triggers this compile produces a garbage-slow
             # speed sample; _auto_record_speed drops it
             self._skip_next_speed_sample = True
@@ -1483,6 +1516,12 @@ class BaguaTrainer:
             if dt > 0:
                 self._step_dt = dt
         self._last_step_mono = now
+        if self._obs_enabled:
+            # fleet view: the per-rank step/step-dt summary the health
+            # beacon (and the metrics exporter) publish
+            from ..obs import export as _obs_export
+
+            _obs_export.note_step(self._step_counter, self._step_dt)
 
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         from ..communication import check_abort
@@ -1490,6 +1529,10 @@ class BaguaTrainer:
 
         check_abort()  # fail fast once a rank/watchdog flagged an abort
         self._step_counter += 1
+        if self._obs_enabled:
+            # every span opened while this step is driven (including the
+            # watchdog waiter's) carries the step number
+            _obs_spans.set_current_step(self._step_counter)
         if self._profiler is not None:
             self._profiler.on_step(self._step_counter - 1)
         # step.straggle: a slow peer gates this step only when the family's
@@ -1547,7 +1590,8 @@ class BaguaTrainer:
         # state.step (which resumes from checkpoints), not the
         # trainer-local call counter
         self._note_traced_fault_fires(state)
-        out = fn(state, batch)
+        with trace_span("step/dispatch"):
+            out = fn(state, batch)
         if self.grad_guard != "off":
             new_state, loss, health_vec = out
             self.step_metrics = {
@@ -1567,6 +1611,18 @@ class BaguaTrainer:
                 out[1], f"train_step[{self._step_counter}]"
             )
         self._auto_record_speed(batch)
+        if self._obs_enabled:
+            # fleet view, worker half: refresh this rank's beacon so the
+            # launcher's heartbeat carries a LIVE step/staleness summary,
+            # not only the unhealthy-event snapshots.  Throttled to ~one
+            # tiny file write per 2 s; no-op without the launcher-injected
+            # beacon path.
+            now = time.monotonic()
+            if now - self._last_beacon_write > 2.0:
+                self._last_beacon_write = now
+                from ..elastic.membership import write_health_beacon
+
+                write_health_beacon()
         return out
 
     # ---- gradient-health sentinel (host-side policy) ---------------------
@@ -1604,14 +1660,25 @@ class BaguaTrainer:
         # min over verdict rows (rank-uniform verdicts replicate; per-rank
         # gossip verdicts stack — this process acts on ALL its local rows,
         # so multi-device processes see every local replica's verdict)
-        if getattr(health_vec, "is_fully_addressable", True):
-            hv = np.asarray(health_vec)
-        else:
-            hv = np.concatenate(
-                [np.asarray(s.data)
-                 for s in health_vec.addressable_shards], axis=0
-            )
+        with trace_span("step/grad_guard_verdict", step=step_no):
+            if getattr(health_vec, "is_fully_addressable", True):
+                hv = np.asarray(health_vec)
+            else:
+                hv = np.concatenate(
+                    [np.asarray(s.data)
+                     for s in health_vec.addressable_shards], axis=0
+                )
         hv = hv.min(axis=0)
+        if self._obs_enabled:
+            # host-safe mirror of the verdict: the flight recorder
+            # republishes these from abort paths where touching a device
+            # array could hang
+            from ..obs import export as _obs_export
+
+            _obs_export.note_step_metrics({
+                "grad_health_step": step_no,
+                "grad_healthy": float(hv.min()),
+            })
         if bool(hv.min() > 0.5):
             self._guard_skips = 0
             return
@@ -1667,6 +1734,17 @@ class BaguaTrainer:
 
         write_health_beacon()
         if abort_msg is not None:
+            # flight recorder: grad-guard abort and skip-budget escalation
+            # both land here — the post-mortem names the offending step and
+            # buckets before the abort flag stops every control loop
+            from ..obs.recorder import dump_flight_record
+
+            dump_flight_record(
+                "grad_guard_abort", reason=abort_msg,
+                extra={"step": step_no, "unhealthy_buckets": bad,
+                       "policy": self.grad_guard,
+                       "consecutive_skips": self._guard_skips},
+            )
             abort(abort_msg)
 
     def _note_traced_fault_fires(self, state: TrainState) -> None:

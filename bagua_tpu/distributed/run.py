@@ -496,6 +496,53 @@ class _GangStop(Exception):
         self.nodes = [int(n) for n in (nodes or [node])]
 
 
+def publish_health_fence(client, epoch: int, tracker, unhealthy) -> str:
+    """Convert chronically unhealthy members into the ``health_fenced``
+    stop event (the same epoch/resize machinery lease expiry rides) and
+    leave the post-mortem artifact: a flight-recorder dump naming the
+    fenced nodes and the health payloads that condemned them — the exit
+    path where the operator most needs the counters.  Returns the stop
+    reason.  Shared by :func:`monitor_elastic` and the chaos fence drill,
+    so the drilled path IS the production path."""
+    from ..elastic import membership as mb
+    from ..obs.recorder import dump_flight_record
+
+    health = {int(n): tracker.health_of(n) for n in unhealthy}
+    reason = (
+        "heartbeat health payload over limit "
+        f"(node(s) {unhealthy}: "
+        + "; ".join(f"{n}={health[int(n)]}" for n in unhealthy) + ")"
+    )
+    client.publish_stop(
+        epoch, mb.STOP_HEALTH, unhealthy[0], reason,
+        rejoin=False, nodes=unhealthy,
+    )
+    dump_flight_record(
+        "health_fence", reason=reason,
+        extra={"nodes": [int(n) for n in unhealthy],
+               "health": {str(n): h for n, h in health.items()}},
+    )
+    return reason
+
+
+def _maybe_write_fleet_snapshot(spec, tracker) -> None:
+    """Coordinator-side fleet view: merge every member's latest heartbeat
+    health payload into the ``BAGUA_OBS_FLEET_OUT`` snapshot (no-op when
+    unset; exception-free — the caller is the monitor loop)."""
+    out = _env.get_obs_fleet_out()
+    if not out:
+        return
+    try:
+        from ..obs.export import write_fleet_snapshot
+
+        write_fleet_snapshot(
+            out, spec.epoch,
+            {nid: tracker.health_of(nid) for nid in spec.ranks},
+        )
+    except Exception as e:  # noqa: BLE001 - monitoring must not die on obs
+        logger.debug("fleet snapshot not written: %s", e)
+
+
 def monitor_elastic(args, procs, client, spec, coordinator, tracker) -> int:
     """Monitor one elastic attempt.  Every launcher: watch local workers +
     the per-epoch stop flag.  The coordinator additionally: expire silent
@@ -558,19 +605,11 @@ def monitor_elastic(args, procs, client, spec, coordinator, tracker) -> int:
                             mb.STOP_LEASE_EXPIRED, expired[0], reason,
                             rejoin=False, nodes=expired,
                         )
+                    _maybe_write_fleet_snapshot(spec, tracker)
                     unhealthy = tracker.unhealthy_members()
                     if unhealthy:
-                        reason = (
-                            "heartbeat health payload over limit "
-                            f"(node(s) {unhealthy}: "
-                            + "; ".join(
-                                f"{n}={tracker.health_of(n)}"
-                                for n in unhealthy
-                            ) + ")"
-                        )
-                        client.publish_stop(
-                            epoch, mb.STOP_HEALTH, unhealthy[0],
-                            reason, rejoin=False, nodes=unhealthy,
+                        reason = publish_health_fence(
+                            client, epoch, tracker, unhealthy
                         )
                         kill_gang(procs)
                         raise _GangStop(
@@ -665,14 +704,18 @@ def run_elastic(args) -> int:
         expect = None
         while True:
             try:
-                if is_coord:
-                    spec = coordinator.run_round(epoch, expect=expect)
-                else:
-                    spec = join_round(
-                        client, epoch,
-                        timeout_s=args.restart_barrier_timeout,
-                    )
-                    epoch = spec.epoch
+                from ..obs.spans import trace_span
+
+                with trace_span("elastic/rendezvous", epoch=epoch,
+                                role="coordinator" if is_coord else "member"):
+                    if is_coord:
+                        spec = coordinator.run_round(epoch, expect=expect)
+                    else:
+                        spec = join_round(
+                            client, epoch,
+                            timeout_s=args.restart_barrier_timeout,
+                        )
+                        epoch = spec.epoch
             except ExcludedFromRound as e:
                 logger.warning("%s", e)
                 counters.incr("elastic/excluded")
@@ -779,6 +822,16 @@ def run_elastic(args) -> int:
                     logger.error(
                         "this node was health-fenced at epoch %d (%s); "
                         "exiting", spec.epoch, s.reason,
+                    )
+                    # the fenced node's own post-mortem: its launcher
+                    # counters flush through the flight recorder (the
+                    # coordinator already dumped the fencing side)
+                    from ..obs.recorder import dump_flight_record
+
+                    dump_flight_record(
+                        "health_fence",
+                        reason=f"this node fenced: {s.reason}",
+                        extra={"nodes": [int(n) for n in s.nodes]},
                     )
                     if is_coord:
                         # the membership store lives in this process, so

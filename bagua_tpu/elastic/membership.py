@@ -280,11 +280,25 @@ _HEALTH_COUNTERS = {
 
 def local_health_snapshot() -> Optional[dict]:
     """This process's health payload from the telemetry counters — None
-    when every counter is zero, so healthy fleets pay no payload bytes."""
+    when every counter is zero AND no obs summary exists, so idle/healthy
+    non-training processes pay no payload bytes.
+
+    A training process additionally rides its per-rank fleet-view summary
+    (``obs`` key: step, step-dt percentiles, staleness, skip counts — see
+    :func:`bagua_tpu.obs.export.local_obs_summary`) on the same channel;
+    the fence scalar (:func:`health_event_count`) ignores it."""
     snap = {
         k: _counters.get(name) for k, name in _HEALTH_COUNTERS.items()
     }
     snap = {k: v for k, v in snap.items() if v}
+    try:
+        from ..obs.export import local_obs_summary
+
+        obs = local_obs_summary()
+    except Exception:  # noqa: BLE001 - health snapshots must never die
+        obs = None
+    if obs:
+        snap["obs"] = obs
     return snap or None
 
 
@@ -344,17 +358,24 @@ def merged_health_source(
     payload (the launcher injects one beacon file PER local rank — a file
     shared across workers would be last-writer-wins, hiding all but one
     worker's events from the fence).  Event counts sum across workers;
-    staleness gauges take the max."""
+    staleness gauges take the max; per-rank ``obs`` fleet-view summaries
+    are kept side by side, keyed by each worker's global rank (the
+    coordinator's fleet snapshot wants per-rank step/dt, not a sum)."""
     readers = [file_health_source(p) for p in paths]
 
     def read() -> Optional[dict]:
         merged: dict = {}
-        for reader in readers:
+        for i, reader in enumerate(readers):
             snap = reader()
             if not snap:
                 continue
             for key, val in snap.items():
-                if key == "async_staleness":
+                if key == "obs":
+                    if isinstance(val, dict):
+                        merged.setdefault("obs", {})[
+                            str(val.get("rank", i))
+                        ] = val
+                elif key == "async_staleness":
                     merged[key] = max(int(merged.get(key, 0)), int(val))
                 else:
                     merged[key] = int(merged.get(key, 0)) + int(val)

@@ -159,6 +159,34 @@ _declare("BAGUA_ELASTIC_HEALTH_FILE", "str", "",
          "async-staleness event counters are published here; the launcher "
          "merges all local beacons and carries them on its lease heartbeat "
          "to the coordinator as a health payload.")
+# -- observability plane (docs/observability.md) --
+_declare("BAGUA_OBS", "enum", "on",
+         "Unified observability plane master switch: step-span tracing, the "
+         "crash flight recorder, and the metrics exporter.  Host-side only "
+         "— the compiled step program is identical in both modes "
+         "(jaxpr-equality-pinned); `off` restores the exact pre-obs host "
+         "behavior.",
+         choices=("on", "off"))
+_declare("BAGUA_OBS_RING", "int", "512",
+         "Span ring-buffer capacity per process; the oldest spans drop "
+         "(drop count retained) so long runs keep a bounded, readable "
+         "tail for the flight recorder.")
+_declare("BAGUA_OBS_DUMP_DIR", "str", "",
+         "Directory for flight-recorder post-mortem dumps (watchdog abort, "
+         "grad-guard escalation, health fence, armed-fault fires, SIGTERM): "
+         "last-N spans + counters snapshot + step metrics, rank-tagged "
+         "JSON.  Empty disables the recorder.")
+_declare("BAGUA_OBS_EXPORT_DIR", "str", "",
+         "Directory the background metrics exporter writes into "
+         "(`metrics.jsonl` one snapshot per line + `metrics.prom` "
+         "Prometheus textfile).  Empty disables the exporter thread.")
+_declare("BAGUA_OBS_EXPORT_INTERVAL_S", "float", "10",
+         "Metrics exporter snapshot period in seconds.")
+_declare("BAGUA_OBS_FLEET_OUT", "str", "",
+         "Coordinator-side fleet snapshot path: the elastic monitor merges "
+         "every member's heartbeat health payload (per-rank step, "
+         "staleness, skip counts, step-dt percentiles) into one atomic "
+         "JSON.  Empty disables.")
 _declare("BAGUA_ELASTIC_FENCE_UNHEALTHY", "int", "0",
          "Coordinator-side health fence: expel a member whose heartbeat "
          "health payload reports at least this many unhealthy events "
@@ -456,6 +484,36 @@ def get_elastic_health_file() -> Optional[str]:
 def get_elastic_fence_unhealthy() -> int:
     """Health-fence threshold (0 = fencing disabled)."""
     return env_int("BAGUA_ELASTIC_FENCE_UNHEALTHY")
+
+
+def get_obs_mode() -> str:
+    """Observability-plane master switch: ``on`` (default) or ``off`` (the
+    exact pre-obs host behavior; the compiled step is identical either
+    way)."""
+    return env_enum("BAGUA_OBS")
+
+
+def get_obs_ring_size() -> int:
+    return env_int("BAGUA_OBS_RING")
+
+
+def get_obs_dump_dir() -> Optional[str]:
+    """Flight-recorder dump directory; None disables the recorder."""
+    return _raw("BAGUA_OBS_DUMP_DIR")
+
+
+def get_obs_export_dir() -> Optional[str]:
+    """Metrics-exporter output directory; None disables the exporter."""
+    return _raw("BAGUA_OBS_EXPORT_DIR")
+
+
+def get_obs_export_interval_s() -> float:
+    return env_float("BAGUA_OBS_EXPORT_INTERVAL_S")
+
+
+def get_obs_fleet_out() -> Optional[str]:
+    """Coordinator-side fleet snapshot path; None disables."""
+    return _raw("BAGUA_OBS_FLEET_OUT")
 
 
 def get_elastic_store_addr() -> Optional[str]:

@@ -103,6 +103,19 @@ _VALID_KINDS = {
 }
 
 
+def _note_fire_to_recorder(spec: "FaultSpec") -> None:
+    """Every fire refreshes a flight-recorder dump naming the firing point
+    (lazy import: the obs package is import-light, but this module must
+    stay loadable even if obs grows heavier; a recorder failure never
+    blocks an injection)."""
+    try:
+        from ..obs import recorder as _obs_recorder
+
+        _obs_recorder.note_fault_fire(spec.point, spec.kind)
+    except Exception as e:  # noqa: BLE001 - injection must not die on obs
+        logger.debug("fault-fire flight dump skipped: %s", e)
+
+
 class InjectedFault(Exception):
     """Marker base for every injected failure, so defense code can tell an
     injected fault from a real one when recording recoveries."""
@@ -186,8 +199,11 @@ class FaultPlan:
         return cls(data)
 
     def arm(self) -> None:
+        armed: Dict[str, int] = {}
         for s in self.specs:
-            counters.incr(f"faults/{s.point}/armed")
+            key = f"faults/{s.point}/armed"
+            armed[key] = armed.get(key, 0) + 1
+        counters.incr_many(armed)
         if self.specs:
             logger.warning(
                 "fault injection ARMED (%d specs): %s — drills/tests only",
@@ -201,6 +217,8 @@ class FaultPlan:
         records the fire), else None.  Step-keyed specs fire when ``step``
         matches; op-keyed specs count queries and fire from op-index
         ``spec.op`` for ``spec.count`` consecutive queries."""
+        fired: Optional[FaultSpec] = None
+        fire_no = 0
         with self._lock:
             for i, s in enumerate(self.specs):
                 if s.point != point:
@@ -216,15 +234,22 @@ class FaultPlan:
                     if idx < s.op:
                         continue
                 self._fires[i] += 1
-
-                counters.incr(f"faults/{point}/fired")
-                logger.warning(
-                    "fault injection: %s fired (kind=%s, fire %d/%s)",
-                    point, s.kind, self._fires[i],
-                    "inf" if s.count < 0 else s.count,
-                )
-                return s
-        return None
+                fired, fire_no = s, self._fires[i]
+                break
+        if fired is None:
+            return None
+        # accounting and the flight-recorder hook run OUTSIDE the plan
+        # lock (like note_traced_fire): the recorder dump does JSON + disk
+        # I/O, and concurrent fault-point queries (heartbeat thread,
+        # watchdog waiter) must not block on it
+        counters.incr(f"faults/{point}/fired")
+        logger.warning(
+            "fault injection: %s fired (kind=%s, fire %d/%s)",
+            point, fired.kind, fire_no,
+            "inf" if fired.count < 0 else fired.count,
+        )
+        _note_fire_to_recorder(fired)
+        return fired
 
     def note_traced_fire(self, spec: FaultSpec) -> None:
         """Host-side accounting for TRACED faults (``grad.poison`` fires
@@ -237,6 +262,7 @@ class FaultPlan:
         counters.incr(f"faults/{spec.point}/fired")
         logger.warning("fault injection: %s fired in-step (kind=%s)",
                        spec.point, spec.kind)
+        _note_fire_to_recorder(spec)
 
     def fired(self, point: str) -> bool:
         with self._lock:

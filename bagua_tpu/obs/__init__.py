@@ -1,0 +1,36 @@
+"""Unified observability plane (docs/observability.md).
+
+Three coupled pieces, instrumented into the real code paths:
+
+* :mod:`~bagua_tpu.obs.spans` — host-side step-span tracer
+  (``trace_span``) with a bounded ring buffer; the trainer, overlap
+  scheduler, async boundaries, checkpoint paths, elastic rendezvous, and
+  watchdog sections all open spans.
+* :mod:`~bagua_tpu.obs.recorder` — crash flight recorder: on watchdog
+  abort, grad-guard escalation, health-fence stop, armed-fault fire, or
+  SIGTERM, dump spans + counters + step metrics to
+  ``BAGUA_OBS_DUMP_DIR``.
+* :mod:`~bagua_tpu.obs.export` — ``METRIC_REGISTRY`` (every counter/gauge
+  name, lint-enforced), the background metrics exporter
+  (JSONL + Prometheus textfile), and the coordinator-side fleet snapshot.
+
+Master switch: ``BAGUA_OBS`` (default on; ``off`` restores the exact
+pre-obs host behavior — the compiled step program is identical either way).
+Import-light: no jax anywhere in the package.
+"""
+
+from .export import (  # noqa: F401
+    METRIC_REGISTRY,
+    MetricsExporter,
+    local_obs_summary,
+    render_prometheus,
+    validate_fleet_snapshot,
+    write_fleet_snapshot,
+)
+from .recorder import (  # noqa: F401
+    dump_flight_record,
+    validate_flight_record,
+)
+# NOTE: the span ring instance is ``spans.recorder`` — deliberately NOT
+# re-exported here, where it would shadow the ``obs.recorder`` submodule
+from .spans import SpanRecorder, span_ring, trace_span  # noqa: F401

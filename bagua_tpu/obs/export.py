@@ -1,0 +1,455 @@
+"""Metrics exporter + fleet view: the sidecar-shaped half of the obs plane.
+
+The reference runs a Flask autotune sidecar every rank POSTs metrics to;
+here the consumers are files an operator (or the ROADMAP's autotune-v2
+scorer) can tail:
+
+* :data:`METRIC_REGISTRY` — every counter/gauge name the package emits,
+  declared once with kind and doc (mirror of ``env.ENV_REGISTRY``).
+  ``bagua-lint``'s ``unregistered-counter`` rule rejects ``counters.incr``
+  /``set_gauge`` call sites whose literal name is not declared here, so a
+  typo'd metric name cannot silently fork a counter.
+* :class:`MetricsExporter` — a background thread that periodically merges
+  ``telemetry.counters``, the trainer's latest ``step_metrics``, and the
+  ``measured_step_dt`` history into ``metrics.jsonl`` (one snapshot per
+  line) and ``metrics.prom`` (a Prometheus textfile) under
+  ``BAGUA_OBS_EXPORT_DIR``.
+* **fleet view** — each worker's per-rank summary
+  (:func:`local_obs_summary`: step, step-dt percentiles, staleness, skip
+  counts) rides the worker's health beacon onto the launcher's lease
+  heartbeat; the coordinator-side monitor merges every member's payload
+  into one fleet snapshot (:func:`write_fleet_snapshot`,
+  ``BAGUA_OBS_FLEET_OUT``).
+
+Import-light (no jax): the launcher's monitor writes the fleet snapshot and
+must not pay a jax import for it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import logging
+import os
+import re
+import threading
+import time
+import weakref
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import env as _env
+from ..faults.inject import FAULT_POINTS
+from ..telemetry import counters
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "METRIC_REGISTRY", "Metric", "is_registered", "any_registered_matches",
+    "MetricsExporter", "render_prometheus", "local_obs_summary",
+    "note_step", "note_step_metrics", "write_fleet_snapshot",
+    "validate_fleet_snapshot", "FLEET_SCHEMA",
+]
+
+
+# ---- metric registry ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Metric:
+    """One declared metric: the single source of truth for its kind and
+    operator-facing documentation (the counter analog of ``env.EnvVar``)."""
+
+    name: str
+    kind: str  # "counter" (monotonic event count) | "gauge" (last value)
+    doc: str
+
+
+METRIC_REGISTRY: Dict[str, Metric] = {}
+
+
+def _declare(name: str, kind: str, doc: str) -> None:
+    assert kind in ("counter", "gauge"), kind
+    METRIC_REGISTRY[name] = Metric(name, kind, doc)
+
+
+# -- communication / watchdog --
+_declare("comm/aborts", "counter",
+         "Cooperative abort flag raises (watchdog fire, grad-guard abort, "
+         "user abort()).")
+_declare("comm/abort_resets", "counter",
+         "reset_abort() recoveries after an abort.")
+# -- gradient-health sentinel --
+_declare("grad_guard/unhealthy_steps", "counter",
+         "Steps whose gradients contained NaN/Inf (any policy).")
+_declare("grad_guard/skipped_steps", "counter",
+         "Unhealthy steps rewound by policy `skip`.")
+_declare("grad_guard/aborts", "counter",
+         "Guard escalations to the comm abort flag (policy `abort`, or the "
+         "consecutive-skip budget).")
+# -- checkpoint integrity chain --
+_declare("ckpt/integrity_failures", "counter",
+         "Checkpoints that failed verification at restore (unreadable step, "
+         "torn sidecar, content-digest mismatch).")
+_declare("ckpt/fallback_restores", "counter",
+         "Restores that landed on an older step after newer checkpoint(s) "
+         "failed verification.")
+_declare("ckpt/verified_restores", "counter",
+         "Restores whose content digest verified against the save-time "
+         "record.")
+_declare("ckpt/stacked_resize_restores", "counter",
+         "Stacked (per-rank) checkpoints re-tiled onto a resized world.")
+# -- async model averaging --
+_declare("async/rounds_launched", "counter",
+         "Averaging rounds launched at negotiated boundaries.")
+_declare("async/rounds_applied", "counter",
+         "Rounds whose delta was applied on this rank.")
+_declare("async/rounds_dropped", "counter",
+         "Rounds discarded without applying (rewind veto, partition, "
+         "catch-up supersede, abort).")
+_declare("async/missed_boundaries", "counter",
+         "This-rank round drops that count as fenceable health events.")
+_declare("async/catchup_syncs", "counter",
+         "Forced synchronous catch-up averages (staleness cap, checkpoint "
+         "sync).")
+_declare("async/staleness_max", "gauge",
+         "Worst rank's applied-round lag observed at the last negotiated "
+         "boundary.")
+_declare("async/aborts_negotiated", "counter",
+         "Negotiated ABORT transitions of the averaging control loop.")
+_declare("async/resumes_negotiated", "counter",
+         "Negotiated RESUME transitions of the averaging control loop.")
+# -- elastic membership / launcher --
+_declare("elastic/rounds", "counter", "Rendezvous rounds completed.")
+_declare("elastic/world_nnodes", "gauge",
+         "Node count of the most recently negotiated world.")
+_declare("elastic/failures", "counter", "Worker-crash stop events.")
+_declare("elastic/lease_expired", "counter", "Lease-expiry stop events.")
+_declare("elastic/leaves", "counter",
+         "Deliberate-departure stop events (watchdog exit, ^C).")
+_declare("elastic/resizes", "counter",
+         "Coordinated resize stop events (standby join).")
+_declare("elastic/health_fenced", "counter",
+         "Members expelled by the heartbeat health fence.")
+_declare("elastic/restarts", "counter", "Elastic gang restarts consumed.")
+_declare("elastic/excluded", "counter",
+         "Rounds this node was excluded from (waited as standby).")
+# -- fault injection (one armed/fired/recovered triple per point) --
+for _point in FAULT_POINTS:
+    _declare(f"faults/{_point}/armed", "counter",
+             f"`{_point}` fault specs armed.")
+    _declare(f"faults/{_point}/fired", "counter",
+             f"`{_point}` faults fired.")
+    _declare(f"faults/{_point}/recovered", "counter",
+             f"`{_point}` faults the defense path recovered from.")
+# -- observability plane self-accounting --
+_declare("obs/flight_dumps", "counter",
+         "Flight-recorder post-mortem dumps written.")
+_declare("obs/export_snapshots", "counter",
+         "Metrics-exporter snapshots written (jsonl line + prom file).")
+
+
+def is_registered(name: str) -> bool:
+    return name in METRIC_REGISTRY
+
+
+def render_metrics_md() -> str:
+    """The ``docs/metrics.md`` reference table, emitted straight from
+    :data:`METRIC_REGISTRY` (``scripts/gen_env_docs.py`` writes/checks it
+    alongside the env-var table)."""
+    lines = [
+        "# Metrics",
+        "",
+        "Generated by `scripts/gen_env_docs.py` from "
+        "`bagua_tpu.obs.export.METRIC_REGISTRY` — do not edit by hand.",
+        "",
+        "Every counter/gauge the package emits is declared in the registry;",
+        "`bagua-lint`'s `unregistered-counter` rule fails CI on any",
+        "`counters.incr`/`set_gauge` call site whose name is not declared",
+        "here, so the table cannot drift from the write sites.  Names export",
+        "to Prometheus as `bagua_<name>` with `/` and `.` mangled to `_`",
+        "(see `prometheus_name`).",
+        "",
+        "| Metric | Kind | Description |",
+        "| --- | --- | --- |",
+    ]
+    for name in sorted(METRIC_REGISTRY):
+        m = METRIC_REGISTRY[name]
+        doc = " ".join(m.doc.split())
+        lines.append(f"| `{name}` | {m.kind} | {doc} |")
+    return "\n".join(lines) + "\n"
+
+
+def any_registered_matches(pattern: str) -> bool:
+    """Whether some registered name fully matches ``pattern`` (a regex) —
+    how the ``unregistered-counter`` lint rule validates f-string call
+    sites like ``f"faults/{point}/fired"``."""
+    rx = re.compile(pattern)
+    return any(rx.fullmatch(name) for name in METRIC_REGISTRY)
+
+
+# ---- per-rank obs summary (the fleet view's worker half) ------------------
+
+_SUMMARY_LOCK = threading.Lock()
+_STEP_DTS: deque = deque(maxlen=64)
+_LAST_STEP: Optional[int] = None
+_LAST_STEP_METRICS: Dict[str, Any] = {}
+
+
+def note_step(step: int, step_dt: Optional[float]) -> None:
+    """Trainer hook (host side, once per step): the latest step number and
+    measured host step cadence, feeding the percentile summary."""
+    global _LAST_STEP
+    with _SUMMARY_LOCK:
+        _LAST_STEP = int(step)
+        if step_dt is not None and step_dt > 0:
+            _STEP_DTS.append(float(step_dt))
+
+
+def note_step_metrics(metrics: Dict[str, Any]) -> None:
+    """Host-safe (already-read-back) step metrics — e.g. the grad guard's
+    one-step-behind verdict.  Values must be plain Python numbers: the
+    flight recorder re-publishes them from paths where touching a device
+    array could hang forever."""
+    with _SUMMARY_LOCK:
+        _LAST_STEP_METRICS.update(metrics)
+
+
+def last_step_metrics() -> Dict[str, Any]:
+    with _SUMMARY_LOCK:
+        return dict(_LAST_STEP_METRICS)
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def local_obs_summary() -> Optional[dict]:
+    """This process's per-rank fleet-view summary: step, step-dt
+    percentiles, staleness gauge, skip counts.  None before the trainer
+    noted any step (launcher processes, pure-eval jobs) — the beacon then
+    carries no obs payload."""
+    with _SUMMARY_LOCK:
+        step = _LAST_STEP
+        dts = sorted(_STEP_DTS)
+    if step is None:
+        return None
+    summary = {
+        "rank": int(_env.get_rank()),
+        "step": step,
+        "staleness": counters.get("async/staleness_max"),
+        "skipped_steps": counters.get("grad_guard/skipped_steps"),
+    }
+    if dts:
+        summary["step_dt_p50"] = round(_percentile(dts, 0.5), 6)
+        summary["step_dt_p90"] = round(_percentile(dts, 0.9), 6)
+    return summary
+
+
+def reset_local_summary() -> None:
+    """Forget the per-rank summary (test isolation)."""
+    global _LAST_STEP
+    with _SUMMARY_LOCK:
+        _LAST_STEP = None
+        _STEP_DTS.clear()
+        _LAST_STEP_METRICS.clear()
+
+
+# ---- Prometheus / JSONL rendering -----------------------------------------
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prometheus_name(name: str) -> str:
+    """``faults/grad.poison/fired`` -> ``bagua_faults_grad_poison_fired``."""
+    return "bagua_" + _PROM_NAME.sub("_", name)
+
+
+def render_prometheus(snapshot: Dict[str, Any]) -> str:
+    """Prometheus textfile exposition of a counters snapshot — HELP/TYPE
+    from the registry; unregistered names (should not exist once the lint
+    rule holds) export as untyped with a marker comment."""
+    lines: List[str] = []
+    for name in sorted(snapshot):
+        value = snapshot[name]
+        pname = prometheus_name(name)
+        metric = METRIC_REGISTRY.get(name)
+        if metric is not None:
+            lines.append(f"# HELP {pname} {' '.join(metric.doc.split())}")
+            lines.append(f"# TYPE {pname} {metric.kind}")
+        else:
+            lines.append(f"# HELP {pname} (unregistered metric name)")
+            lines.append(f"# TYPE {pname} untyped")
+        lines.append(f"{pname} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def _atomic_write(path: str, text: str) -> None:
+    # pid AND thread in the temp name: the flight recorder writes from
+    # whichever thread hit the defense path (watchdog monitor, SIGTERM
+    # helper, main), and two threads sharing one temp file would truncate
+    # each other's in-progress write before the replace
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+class MetricsExporter:
+    """Background thread (the analog of the reference's Flask sidecar):
+    every ``interval_s``, snapshot the telemetry counters + the per-rank
+    obs summary + the latest host-safe step metrics, append one JSON line
+    to ``<directory>/metrics.jsonl``, and atomically rewrite
+    ``<directory>/metrics.prom``.
+
+    One counter-lock acquisition per snapshot (``counters.snapshot()``) —
+    never one per metric — and one batched self-increment
+    (``counters.incr_many``)."""
+
+    def __init__(self, directory: str, interval_s: Optional[float] = None,
+                 trainer: Optional[Any] = None):
+        self.directory = str(directory)
+        self.interval_s = float(
+            _env.get_obs_export_interval_s() if interval_s is None
+            else interval_s
+        )
+        self._trainer = weakref.ref(trainer) if trainer is not None else None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="bagua-obs-exporter", daemon=True
+        )
+
+    def attach_trainer(self, trainer: Any) -> None:
+        self._trainer = weakref.ref(trainer)
+
+    def start(self) -> "MetricsExporter":
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread.start()
+        return self
+
+    def export_once(self) -> dict:
+        """One snapshot (also the thread's body): returns the JSONL record
+        for tests/round-trips."""
+        snap = counters.snapshot()
+        record: Dict[str, Any] = {
+            "time_unix": time.time(),
+            "collected_at": snap.collected_at,
+            "rank": int(_env.get_rank()),
+            "counters": dict(snap),
+        }
+        summary = local_obs_summary()
+        if summary:
+            record["obs"] = summary
+        metrics = last_step_metrics()
+        if metrics:
+            record["step_metrics"] = metrics
+        trainer = self._trainer() if self._trainer is not None else None
+        if trainer is not None:
+            dt = getattr(trainer, "measured_step_dt", None)
+            if callable(dt):
+                record["measured_step_dt"] = dt()
+        with open(os.path.join(self.directory, "metrics.jsonl"), "a") as f:
+            f.write(json.dumps(record) + "\n")
+        _atomic_write(os.path.join(self.directory, "metrics.prom"),
+                      render_prometheus(snap))
+        counters.incr_many({"obs/export_snapshots": 1})
+        return record
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.export_once()
+            except Exception as e:  # noqa: BLE001 - export must not kill
+                logger.warning("metrics export failed: %s", e)
+
+    def stop(self, final_export: bool = True) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        if final_export:
+            try:
+                self.export_once()
+            except Exception as e:  # noqa: BLE001
+                logger.debug("final metrics export failed: %s", e)
+
+
+_GLOBAL_EXPORTER: Optional[MetricsExporter] = None
+_GLOBAL_EXPORTER_LOCK = threading.Lock()
+
+
+def maybe_start_global_exporter(trainer: Optional[Any] = None
+                                ) -> Optional[MetricsExporter]:
+    """Process-wide exporter, started once when ``BAGUA_OBS_EXPORT_DIR`` is
+    set (one thread no matter how many trainers — the global-watchdog
+    pattern); later trainers re-attach so the freshest one's step metrics
+    export."""
+    directory = _env.get_obs_export_dir()
+    if not directory:
+        return None
+    global _GLOBAL_EXPORTER
+    with _GLOBAL_EXPORTER_LOCK:
+        if _GLOBAL_EXPORTER is None:
+            _GLOBAL_EXPORTER = MetricsExporter(
+                directory, trainer=trainer
+            ).start()
+            atexit.register(_GLOBAL_EXPORTER.stop)
+        elif trainer is not None:
+            _GLOBAL_EXPORTER.attach_trainer(trainer)
+        return _GLOBAL_EXPORTER
+
+
+# ---- fleet snapshot (coordinator side) ------------------------------------
+
+FLEET_SCHEMA = "bagua-obs-fleet-v1"
+
+
+def write_fleet_snapshot(path: str, epoch: int,
+                         members: Dict[int, Optional[dict]]) -> bool:
+    """Coordinator-side fleet view: merge every member's latest heartbeat
+    health payload (``LeaseTracker.health_of``) into one atomic JSON
+    snapshot — per node: the fence-relevant health events plus the per-rank
+    ``obs`` summaries its launcher merged from the workers' beacons.
+    Exception-free (the caller is the launcher's monitor loop)."""
+    try:
+        ranks: Dict[str, dict] = {}
+        for node_id, payload in members.items():
+            payload = payload or {}
+            obs = payload.get("obs") or {}
+            if "step" in obs:
+                # a single-rank summary (the in-process heartbeat default
+                # source) normalizes to the launcher's per-rank shape
+                obs = {str(obs.get("rank", 0)): obs}
+            ranks[str(int(node_id))] = {
+                "health": {k: v for k, v in payload.items() if k != "obs"},
+                "obs": obs,
+            }
+        record = {
+            "schema": FLEET_SCHEMA,
+            "time_unix": time.time(),
+            "epoch": int(epoch),
+            "nnodes": len(members),
+            "ranks": ranks,
+        }
+        _atomic_write(str(path), json.dumps(record, indent=1, sort_keys=True))
+        return True
+    except OSError as e:
+        logger.debug("fleet snapshot not written: %s", e)
+        return False
+
+
+def validate_fleet_snapshot(record: dict) -> List[str]:
+    """Schema problems with a fleet snapshot ([] = valid) — the drill/test
+    gate."""
+    problems: List[str] = []
+    if record.get("schema") != FLEET_SCHEMA:
+        problems.append(f"schema != {FLEET_SCHEMA}")
+    for key, typ in (("time_unix", (int, float)), ("epoch", int),
+                     ("nnodes", int), ("ranks", dict)):
+        if not isinstance(record.get(key), typ):
+            problems.append(f"missing/mistyped {key}")
+    for nid, entry in (record.get("ranks") or {}).items():
+        if not isinstance(entry, dict) or "health" not in entry \
+                or "obs" not in entry:
+            problems.append(f"rank {nid}: missing health/obs")
+    return problems

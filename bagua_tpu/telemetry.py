@@ -33,15 +33,31 @@ logger = logging.getLogger(__name__)
 # jax import just to count membership transitions.
 
 
+class CounterSnapshot(dict):
+    """A counters snapshot: a plain ``name -> value`` dict (so every
+    existing consumer — JSON dumps, delta arithmetic — keeps working)
+    carrying a monotonic ``collected_at`` stamp, so the metrics exporter
+    and flight recorder can order/age snapshots without a second clock
+    read racing the lock."""
+
+    def __init__(self, values: Dict[str, Union[int, float]],
+                 collected_at: float):
+        super().__init__(values)
+        self.collected_at = collected_at
+
+
 class TelemetryCounters:
     """Process-wide named counters/gauges (thread-safe).
 
     The reference exports OTel metrics next to its spans; here the
     consumers are in-process (the elastic launcher's membership/resize
-    accounting, tests, the drill scripts' JSON artifacts), so a dict under
-    a lock is the whole implementation.  ``incr`` is for monotonic event
-    counts (``elastic/resizes``), ``set_gauge`` for last-value readings
-    (``elastic/world_nnodes``)."""
+    accounting, the obs exporter, tests, the drill scripts' JSON
+    artifacts), so a dict under a lock is the whole implementation.
+    ``incr`` is for monotonic event counts (``elastic/resizes``),
+    ``set_gauge`` for last-value readings (``elastic/world_nnodes``);
+    every name is declared in
+    :data:`bagua_tpu.obs.export.METRIC_REGISTRY` (bagua-lint's
+    ``unregistered-counter`` rule enforces it)."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -52,6 +68,14 @@ class TelemetryCounters:
             self._values[name] = self._values.get(name, 0) + n
             return self._values[name]
 
+    def incr_many(self, updates: Dict[str, Union[int, float]]) -> None:
+        """Batch increment under ONE lock acquisition — for writer loops
+        (fault-plan arming, exporter self-accounting) that would otherwise
+        take the lock once per metric."""
+        with self._lock:
+            for name, n in updates.items():
+                self._values[name] = self._values.get(name, 0) + n
+
     def set_gauge(self, name: str, value: Union[int, float]) -> None:
         with self._lock:
             self._values[name] = value
@@ -60,9 +84,11 @@ class TelemetryCounters:
         with self._lock:
             return self._values.get(name, 0)
 
-    def snapshot(self) -> Dict[str, Union[int, float]]:
+    def snapshot(self) -> CounterSnapshot:
+        """Point-in-time copy with a monotonic ``collected_at`` stamp
+        (still a plain dict to every old consumer)."""
         with self._lock:
-            return dict(self._values)
+            return CounterSnapshot(self._values, time.monotonic())
 
     def reset(self) -> None:
         with self._lock:

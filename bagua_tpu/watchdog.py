@@ -96,11 +96,16 @@ class HangWatchdog:
         # get_ident() made an inner (nested) watch clobber the outer entry
         # and its exit pop the shared key — leaving the outer section
         # unwatched for the rest of its run
+        from .obs.spans import trace_span
+
         token = object()
         with self._lock:
             self._active[token] = (label, time.monotonic())
         try:
-            yield
+            # the watched section doubles as a span: a post-mortem's span
+            # tail shows exactly which section the waiter was pinned in
+            with trace_span(f"watchdog/{label}"):
+                yield
         finally:
             with self._lock:
                 self._active.pop(token, None)
@@ -186,6 +191,16 @@ class HangWatchdog:
                         from .communication import abort
 
                         abort(f"watchdog: {label} stuck for {dt:.0f} s")
+                        # flight recorder: the post-mortem artifact for
+                        # this hang episode — host-only reads (span ring,
+                        # counters), so a wedged device cannot block it
+                        from .obs.recorder import dump_flight_record
+
+                        dump_flight_record(
+                            "watchdog_abort",
+                            reason=f"section {label!r} stuck for {dt:.0f} s "
+                                   f"(timeout {self.timeout_s:.0f} s)",
+                        )
                     # dump stacks once per hang episode, not every tick
                     faulthandler.dump_traceback(file=sys.stderr)
                     self._armed = False

@@ -32,16 +32,30 @@ deterministically:
    drops every negotiation round; the applied-round counter stalls, the
    staleness tracker catches it at the cap, and the forced synchronous
    catch-up re-syncs the replicas bit-identically while training continues.
+8. **chronic bad health → coordinator fence**: unhealthy worker beacons
+   ride the lease heartbeat; the tracker names the node, the production
+   fence path (``distributed.run.publish_health_fence``) publishes the
+   ``health_fenced`` stop — and the coordinator-side fleet snapshot
+   records every rank's obs summary.
+
+Every fault-driven failure mode must also leave a **schema-valid
+flight-recorder dump** (``bagua_tpu.obs.recorder``) naming the firing
+fault point — asserted per drill and recorded in the matrix.
 
 Writes ``CHAOS_DRILL.json`` (schema-gated in ``tests/test_bench_sanity.py``);
 exit code 0 iff every fault was detected AND recovered.
 
-Usage: python scripts/chaos_drill.py
+Usage: python scripts/chaos_drill.py [--only DRILL ...]
+       (--only runs a subset — the CI smoke trace — and does NOT rewrite
+       CHAOS_DRILL.json unless --out is given)
 """
 
+import argparse
+import glob
 import json
 import os
 import sys
+import tempfile
 import time
 
 os.environ.setdefault("XLA_FLAGS", "")
@@ -53,6 +67,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # of earlier drills' step losses would race the drill for the single
 # armed fire — keep it out of the picture
 os.environ["BAGUA_COMM_TIMEOUT_S"] = "off"
+# flight-recorder dumps land here; every drill asserts its failure mode
+# left a schema-valid artifact naming the firing fault point.  Always a
+# FRESH directory — an inherited BAGUA_OBS_DUMP_DIR could hold stale
+# flight_*.json from a previous run, and a stale artifact satisfying a
+# drill's expectation would mask a broken recorder (the exact regression
+# this gate exists to catch)
+DUMP_DIR = os.environ["BAGUA_OBS_DUMP_DIR"] = tempfile.mkdtemp(
+    prefix="chaos_obs_"
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -78,6 +101,59 @@ def _counter_deltas(before):
     return {k: after.get(k, 0) - before.get(k, 0)
             for k in sorted(keys)
             if after.get(k, 0) != before.get(k, 0)}
+
+
+#: drill name -> the fault point (or non-fault trigger) whose
+#: flight-recorder dump the drill must leave behind
+FLIGHT_EXPECTATIONS = {
+    "store_flake_retry": {"fault_point": "store.op"},
+    "heartbeat_loss_lease_expiry": {"fault_point": "elastic.heartbeat"},
+    "checkpoint_corruption_fallback_restore": {"fault_point": "ckpt.write"},
+    "nan_grad_skip_loss_continuity": {"fault_point": "grad.poison"},
+    "collective_hang_watchdog_recovery": {"fault_point": "collective.hang",
+                                          "trigger": "watchdog_abort"},
+    "straggler_throughput_degrades": {"fault_point": "step.straggle"},
+    "async_partition_staleness_catchup": {"fault_point": "async.partition"},
+    "health_fence_flight_record": {"trigger": "health_fence"},
+}
+
+
+def _flight_record_check(expect):
+    """Scan the dump dir for a schema-valid flight record matching the
+    expectation (fault point and/or trigger); returns the verdict dict the
+    drill matrix records."""
+    from bagua_tpu.obs import recorder as obs_recorder
+
+    point = expect.get("fault_point")
+    trigger = expect.get("trigger")
+    found_point = found_trigger = False
+    problems = []
+    for path in sorted(glob.glob(os.path.join(DUMP_DIR, "flight_*.json"))):
+        try:
+            rec = json.load(open(path))
+        except (OSError, ValueError) as e:
+            problems.append(f"{os.path.basename(path)}: unreadable ({e})")
+            continue
+        bad = obs_recorder.validate_flight_record(rec)
+        if bad:
+            problems.append(f"{os.path.basename(path)}: {bad}")
+            continue
+        if point and (rec.get("fault_point") == point
+                      or point in rec.get("fired_faults", {})):
+            found_point = True
+        if trigger and rec.get("trigger") == trigger:
+            found_trigger = True
+    # a match only counts when its containing dump schema-validated (the
+    # loop skips invalid dumps before matching), so found == schema-valid
+    ok = (found_point or not point) and (found_trigger or not trigger)
+    verdict = {"schema_valid": ok, "found": ok}
+    if point:
+        verdict["fault_point"] = point
+    if trigger:
+        verdict["trigger"] = trigger
+    if problems:
+        verdict["problems"] = problems[:5]
+    return verdict
 
 
 def drill_store_flake():
@@ -469,8 +545,81 @@ def drill_async_partition_catchup():
                        f"{all(synced_rows_ok)}"}
 
 
-def main():
-    import tempfile
+def drill_health_fence(tmp):
+    """Chronic bad worker health → the coordinator's fence, end-to-end
+    through the PRODUCTION pieces: per-rank beacon files → the launcher's
+    merged heartbeat payload → LeaseTracker harvesting →
+    ``publish_health_fence`` (the exact function monitor_elastic calls),
+    which publishes the ``health_fenced`` stop AND dumps the flight
+    record; the coordinator-side fleet snapshot is written and
+    schema-validated alongside."""
+    from bagua_tpu.contrib.utils.store import InMemoryStore
+    from bagua_tpu.distributed.run import publish_health_fence
+    from bagua_tpu.elastic import membership as mb
+    from bagua_tpu.obs import export as obs_export
+
+    store = InMemoryStore()
+    client = mb.MembershipClient(store, node_id=0, max_nnodes=2)
+    # node 1's workers report non-finite-gradient steps via their beacons
+    beacons = [os.path.join(tmp, f"fence_beacon.r{i}") for i in range(2)]
+    with open(beacons[0], "w") as f:
+        json.dump({"grad_unhealthy": 2,
+                   "obs": {"rank": 2, "step": 41, "step_dt_p50": 0.01,
+                           "step_dt_p90": 0.02}}, f)
+    with open(beacons[1], "w") as f:
+        json.dump({"async_missed": 1,
+                   "obs": {"rank": 3, "step": 40, "step_dt_p50": 0.01,
+                           "step_dt_p90": 0.03}}, f)
+    hb = mb.LeaseHeartbeat(
+        lambda: store, node_id=1, epoch=0, interval_s=0.05, max_nnodes=2,
+        health_source=mb.merged_health_source(beacons),
+    ).start()
+    try:
+        client.beat(0, 1)  # the coordinator's own (healthy) heartbeat
+        tracker = mb.LeaseTracker(client, epoch=0, member_ids=[1],
+                                  ttl_s=30.0, fence_unhealthy_after=3,
+                                  observe_only_ids=[0])
+        unhealthy = []
+        deadline = time.time() + 10
+        while not unhealthy and time.time() < deadline:
+            time.sleep(0.1)
+            tracker.poll()
+            unhealthy = tracker.unhealthy_members()
+        detected = unhealthy == [1]
+        if detected:
+            publish_health_fence(client, 0, tracker, unhealthy)
+        stop = client.read_stop(0)
+        fenced = bool(stop and stop["kind"] == mb.STOP_HEALTH
+                      and stop["nodes"] == [1])
+        fleet_path = os.path.join(tmp, "fleet_snapshot.json")
+        obs_export.write_fleet_snapshot(
+            fleet_path, 0, {nid: tracker.health_of(nid) for nid in (0, 1)})
+        fleet = json.load(open(fleet_path))
+        fleet_ok = (
+            not obs_export.validate_fleet_snapshot(fleet)
+            and fleet["ranks"]["1"]["obs"].get("2", {}).get("step") == 41
+            and fleet["ranks"]["1"]["health"].get("grad_unhealthy") == 2
+        )
+    finally:
+        hb.stop()
+    return {"injected": True, "detected": bool(detected),
+            "recovered": bool(fenced and fleet_ok),
+            "fleet_snapshot_valid": bool(fleet_ok),
+            "details": f"tracker named node(s) {unhealthy}; stop event "
+                       f"{stop and stop['kind']}; fleet snapshot carries "
+                       f"per-rank obs summaries (valid: {fleet_ok})"}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", nargs="+", default=None, metavar="DRILL",
+                    help="run only the named drill(s) — the CI smoke trace; "
+                         "CHAOS_DRILL.json is NOT rewritten unless --out is "
+                         "also given")
+    ap.add_argument("--out", default=None,
+                    help="output path (default: CHAOS_DRILL.json for the "
+                         "full matrix, none for --only subsets)")
+    args = ap.parse_args(argv)
 
     t0 = time.time()
     tmp = tempfile.mkdtemp(prefix="chaos_drill_")
@@ -485,7 +634,14 @@ def main():
         "collective_hang_watchdog_recovery": drill_collective_hang,
         "straggler_throughput_degrades": drill_straggler_throughput,
         "async_partition_staleness_catchup": drill_async_partition_catchup,
+        "health_fence_flight_record": lambda: drill_health_fence(tmp),
     }
+    if args.only:
+        unknown = [n for n in args.only if n not in drills]
+        if unknown:
+            ap.error(f"unknown drill(s) {unknown}; choose from "
+                     f"{sorted(drills)}")
+        drills = {n: drills[n] for n in args.only}
     results = {}
     for name, fn in drills.items():
         print(f"=== {name} ===", flush=True)
@@ -496,11 +652,20 @@ def main():
                              "recovered": False,
                              "details": f"drill crashed: "
                                         f"{type(e).__name__}: {e}"}
+        expect = FLIGHT_EXPECTATIONS.get(name)
+        if expect is not None:
+            # the failure mode must have left its post-mortem artifact: a
+            # schema-valid flight dump naming the firing fault point
+            results[name]["flight_record"] = _flight_record_check(expect)
         print(f"    {results[name]}", flush=True)
         inject.clear_plan()
         bagua_tpu.reset_abort()
 
-    passed = all(r["detected"] and r["recovered"] for r in results.values())
+    passed = all(
+        r["detected"] and r["recovered"]
+        and r.get("flight_record", {}).get("schema_valid", True)
+        for r in results.values()
+    )
     record = {
         "drill": "chaos",
         "pass": passed,
@@ -510,10 +675,14 @@ def main():
         "faults": results,
         "counters": _counter_deltas(counters_before),
     }
-    with open(OUT, "w") as f:
-        json.dump(record, f, indent=2, sort_keys=True)
-        f.write("\n")
-    print(f"wrote {OUT} (pass={passed})")
+    out = args.out or (None if args.only else OUT)
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out} (pass={passed})")
+    else:
+        print(f"subset pass={passed} (no artifact written; use --out)")
     return 0 if passed else 1
 
 

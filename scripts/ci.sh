@@ -24,6 +24,13 @@ echo "=== generated docs in sync (API reference + env-var table) ==="
 JAX_PLATFORMS=cpu python scripts/gen_api_docs.py --check
 JAX_PLATFORMS=cpu python scripts/gen_env_docs.py --check
 
+echo "=== obs smoke trace (flight recorder on one live drill) ==="
+# One drill from the chaos matrix with the observability plane on: the
+# drill itself asserts its flight-recorder dump exists, schema-validates,
+# and names the firing fault point (exit code carries the verdict).  The
+# full-matrix CHAOS_DRILL.json is schema-gated in test_bench_sanity.py.
+python scripts/chaos_drill.py --only nan_grad_skip_loss_continuity
+
 echo "=== chaos fast subset (fault injection -> detection -> recovery) ==="
 # The deterministic slice of scripts/chaos_drill.py: every injection point
 # fires, every detector sees it, every recovery completes.  The committed

@@ -40,6 +40,9 @@ MODULES = [
     "bagua_tpu.faults.inject",
     "bagua_tpu.env",
     "bagua_tpu.telemetry",
+    "bagua_tpu.obs.spans",
+    "bagua_tpu.obs.recorder",
+    "bagua_tpu.obs.export",
     "bagua_tpu.profiling",
     "bagua_tpu.parallel.mesh",
     "bagua_tpu.parallel.tensor_parallel",

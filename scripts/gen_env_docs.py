@@ -1,10 +1,12 @@
 #!/usr/bin/env python
-"""Generate docs/env_vars.md from the env-var registry.
+"""Generate docs/env_vars.md and docs/metrics.md from their registries.
 
-The table is emitted straight from ``bagua_tpu.env.ENV_REGISTRY`` — the same
-declaration the accessors read — so the reference cannot drift from the code.
-``bagua-lint``'s ``raw-env-read`` rule closes the loop: a ``BAGUA_*`` read
-outside the registry fails CI, so an undocumented tunable cannot exist.
+Both tables are emitted straight from the declarations the code reads —
+``bagua_tpu.env.ENV_REGISTRY`` and ``bagua_tpu.obs.export.METRIC_REGISTRY``
+— so the references cannot drift from the code.  ``bagua-lint`` closes each
+loop: ``raw-env-read`` fails CI on a ``BAGUA_*`` read outside the env
+registry, ``unregistered-counter`` fails it on a counter write site whose
+name is not declared in the metric registry.
 
 Usage: python scripts/gen_env_docs.py [--check]
 """
@@ -17,29 +19,37 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-OUT = os.path.join(REPO, "docs", "env_vars.md")
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--check", action="store_true",
-                    help="verify the committed table matches (exit 1 on drift)")
+                    help="verify the committed tables match (exit 1 on drift)")
     args = ap.parse_args()
 
     from bagua_tpu.env import render_env_vars_md
+    from bagua_tpu.obs.export import render_metrics_md
 
-    text = render_env_vars_md()
+    targets = [
+        (os.path.join(REPO, "docs", "env_vars.md"), render_env_vars_md()),
+        (os.path.join(REPO, "docs", "metrics.md"), render_metrics_md()),
+    ]
     if args.check:
-        old = open(OUT).read() if os.path.exists(OUT) else None
-        if old != text:
-            print("docs/env_vars.md out of date; regenerate with: "
+        stale = []
+        for out, text in targets:
+            old = open(out).read() if os.path.exists(out) else None
+            if old != text:
+                stale.append(os.path.relpath(out, REPO))
+        if stale:
+            print(f"{', '.join(stale)} out of date; regenerate with: "
                   "python scripts/gen_env_docs.py")
             return 1
-        print("docs/env_vars.md up to date")
+        print("docs/env_vars.md + docs/metrics.md up to date")
         return 0
-    with open(OUT, "w") as f:
-        f.write(text)
-    print(f"wrote {OUT}")
+    for out, text in targets:
+        with open(out, "w") as f:
+            f.write(text)
+        print(f"wrote {out}")
     return 0
 
 

@@ -254,6 +254,58 @@ def test_per_step_reflatten_repo_is_clean():
     ]
 
 
+def test_unregistered_counter_positive_misspelled():
+    # the canonical typo: a counter name one character off from a
+    # registered one silently forks an unread metric
+    found = rules_of("""
+        from bagua_tpu.telemetry import counters
+
+        def on_abort():
+            counters.incr("comm/abortss")
+            counters.set_gauge("async/staleness_maximum", 3)
+    """)
+    assert found.count("unregistered-counter") == 2
+
+
+def test_unregistered_counter_incr_many_and_fstring():
+    # literal dict keys in incr_many are checked too; f-string names pass
+    # when SOME registered name fits the template, fail when none does
+    found = rules_of("""
+        from bagua_tpu.telemetry import counters
+
+        def on_fire(point):
+            counters.incr_many({"obs/flight_dumps": 1,
+                                "obs/flite_dumps": 1})
+            counters.incr(f"faults/{point}/fired")
+            counters.incr(f"faults/{point}/exploded")
+    """)
+    assert found.count("unregistered-counter") == 2
+
+
+def test_unregistered_counter_negative():
+    # registered literals, matching f-string templates, and statically
+    # unresolvable names (a variable) are all clean
+    found = rules_of("""
+        from bagua_tpu.telemetry import counters
+
+        def ok(name):
+            counters.incr("comm/aborts")
+            counters.set_gauge("async/staleness_max", 2)
+            counters.incr_many({"grad_guard/skipped_steps": 1})
+            counters.incr(f"faults/{name}/recovered")
+            counters.incr(name)
+    """)
+    assert "unregistered-counter" not in found
+
+
+def test_unregistered_counter_repo_is_clean():
+    """Every counter write site in the package names a registered metric."""
+    findings = run_ast_rules([PKG], rel_to=REPO)
+    assert not [f for f in findings if f.rule == "unregistered-counter"], [
+        (f.path, f.line) for f in findings if f.rule == "unregistered-counter"
+    ]
+
+
 # ---- suppressions ---------------------------------------------------------
 
 

@@ -131,12 +131,36 @@ def test_chaos_drill_artifact_schema():
         "collective_hang_watchdog_recovery",
         "straggler_throughput_degrades",
         "async_partition_staleness_catchup",
+        "health_fence_flight_record",
     }
     assert required <= set(record["faults"]), sorted(record["faults"])
     for name, fault in record["faults"].items():
         assert fault["injected"] is True, name
         assert fault["detected"] is True, (name, fault["details"])
         assert fault["recovered"] is True, (name, fault["details"])
+    # observability plane (ISSUE 7): every fault-driven failure mode left a
+    # schema-valid flight-recorder dump naming the firing fault point, and
+    # the fence drill's coordinator-side fleet snapshot schema-validated
+    flight_points = {
+        "store_flake_retry": "store.op",
+        "heartbeat_loss_lease_expiry": "elastic.heartbeat",
+        "checkpoint_corruption_fallback_restore": "ckpt.write",
+        "nan_grad_skip_loss_continuity": "grad.poison",
+        "collective_hang_watchdog_recovery": "collective.hang",
+        "straggler_throughput_degrades": "step.straggle",
+        "async_partition_staleness_catchup": "async.partition",
+    }
+    for name, point in flight_points.items():
+        flight = record["faults"][name]["flight_record"]
+        assert flight["schema_valid"] is True, (name, flight)
+        assert flight["fault_point"] == point, (name, flight)
+    hang_flight = record["faults"]["collective_hang_watchdog_recovery"][
+        "flight_record"]
+    assert hang_flight["trigger"] == "watchdog_abort", hang_flight
+    fence = record["faults"]["health_fence_flight_record"]
+    assert fence["flight_record"]["trigger"] == "health_fence", fence
+    assert fence["flight_record"]["schema_valid"] is True, fence
+    assert fence["fleet_snapshot_valid"] is True, fence
     # the matrix-level verdict and the telemetry trail both recorded
     assert record["pass"] is True
     counters = record["counters"]
@@ -150,6 +174,8 @@ def test_chaos_drill_artifact_schema():
     for key in ("async/rounds_launched", "async/rounds_dropped",
                 "async/missed_boundaries", "async/catchup_syncs"):
         assert counters.get(key, 0) >= 1, key
+    # the flight recorder's own accounting (ISSUE 7)
+    assert counters.get("obs/flight_dumps", 0) >= 1
 
 
 def test_straggler_bench_artifact_schema():

@@ -34,6 +34,8 @@ def test_doc_pages_exist():
         "docs/api/index.md",
         "docs/analysis.md",
         "docs/env_vars.md",
+        "docs/metrics.md",
+        "docs/observability.md",
         "docs/tutorials/porting.md",
         "docs/tutorials/performance.md",
     ):
