@@ -392,11 +392,13 @@ class AsyncModelAverageAlgorithm(Algorithm):
             watchdog.watch("async-catchup") if watchdog is not None
             else nullcontext()
         )
+        _t0 = time.monotonic()
         with trace_span("async/catchup", step=step, reason=reason,
                         launched=self._rounds_launched,
                         applied=self._rounds_applied), guard:
             avg = self._avg_fn(state.params)
             jax.block_until_ready(avg)
+        self._note_collective_phase(trainer, time.monotonic() - _t0)
         state = state._replace(params=avg)
         self._rounds_applied = self._rounds_launched
         counters.incr("async/catchup_syncs")
@@ -418,6 +420,15 @@ class AsyncModelAverageAlgorithm(Algorithm):
             return self._agreed_dt
         fn = getattr(trainer, "measured_step_dt", None)
         return fn() if callable(fn) else None
+
+    @staticmethod
+    def _note_collective_phase(trainer, seconds: float) -> None:
+        """Attribute a host-visible synchronization wait (negotiate gather,
+        catch-up average) to the anomaly detector's ``collective`` phase —
+        these boundaries are where a slow peer gates this rank."""
+        note = getattr(trainer, "note_phase_duration", None)
+        if callable(note):
+            note("collective", seconds)
 
     def _gated_straggle(self, trainer, sync_point: str) -> None:
         """Injected straggler stall at a gated boundary, reported back to
@@ -535,12 +546,14 @@ class AsyncModelAverageAlgorithm(Algorithm):
             )
             # span: the negotiation gather is where a slow peer gates every
             # rank — its duration IS the straggler wait
+            _t0 = time.monotonic()
             with trace_span("async/negotiate", step=step,
                             launched=self._rounds_launched,
                             applied=self._rounds_applied):
                 gathered = _negotiate(
                     [float(my_req), float(applied_after)], watchdog
                 )
+            self._note_collective_phase(trainer, time.monotonic() - _t0)
             req = float(np.max(gathered[:, 0]))
             min_applied = int(np.min(gathered[:, 1]))
             if req >= _REQ_ABORT:

@@ -17,6 +17,7 @@ done by XLA's async collectives.  What survives of the scheduler is its
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
@@ -485,6 +486,25 @@ class BaguaTrainer:
 
             _obs_export.maybe_start_global_exporter(self)
             _obs_recorder.maybe_install_signal_hook()
+        #: step-time anomaly detector (docs/observability.md): rolling
+        #: median/MAD baseline over the RAW host cadence (injected stalls
+        #: included — a stall IS the anomaly an operator wants flagged,
+        #: while measured_step_dt subtracts it to stay an honest dilation
+        #: base) plus the per-phase host durations accumulated below
+        self.anomaly_detector = None
+        if self._obs_enabled and env.get_obs_anomaly_mode() == "on":
+            from ..obs.anomaly import StepAnomalyDetector
+
+            self.anomaly_detector = StepAnomalyDetector()
+        #: host phase durations of the step currently being driven
+        #: (dispatch / collective / optimizer); harvested into the anomaly
+        #: detector when the next cadence sample closes the window
+        self._phase_durations: Dict[str, float] = {}
+        #: the current step triggered a compile or a state migration: its
+        #: wall window is expected to be huge and is neither an anomaly
+        #: nor baseline material (the speed tracker's
+        #: ``_skip_next_speed_sample`` mirror)
+        self._anomaly_skip_window = False
         self._speed_tracker = StatisticalAverage()
         self._last_report_time = time.time()
         self._last_speed_time = time.time()
@@ -1491,8 +1511,10 @@ class BaguaTrainer:
                             overlap=overlap):
                 self._step_cache[key] = self._make_step_fn(self._plan)
             # the step that triggers this compile produces a garbage-slow
-            # speed sample; _auto_record_speed drops it
+            # speed sample; _auto_record_speed drops it — and the anomaly
+            # detector skips the window for the same reason
             self._skip_next_speed_sample = True
+            self._anomaly_skip_window = True
         return self._step_cache[key]
 
     def measured_step_dt(self) -> Optional[float]:
@@ -1508,13 +1530,96 @@ class BaguaTrainer:
         (e.g. an async boundary's ``step.straggle`` sleep) so the next
         cadence sample subtracts it — see :meth:`measured_step_dt`."""
         self._last_straggle_sleep += float(seconds)
+        self._note_stall_phase(seconds)
+
+    def note_phase_duration(self, phase: str, seconds: float) -> None:
+        """Attribute host seconds of the current step to a phase
+        (``dispatch`` / ``collective`` / ``optimizer``) for the anomaly
+        detector's ``straggler_suspect`` breakdown.  Algorithms call this
+        around their host-visible waits (async negotiate/catch-up)."""
+        if self.anomaly_detector is None or seconds <= 0:
+            return
+        self._phase_durations[phase] = (
+            self._phase_durations.get(phase, 0.0) + float(seconds)
+        )
+
+    def _note_stall_phase(self, seconds: float) -> None:
+        """Phase-attribute an injected ``step.straggle`` stall: the
+        straggler's OWN process is locally slow (``dispatch`` — that is
+        what a genuinely slow host looks like), a gated peer is *waiting*
+        (``collective``)."""
+        if self.anomaly_detector is None or seconds <= 0:
+            return
+        from ..faults import inject as _inject
+
+        self.note_phase_duration(
+            "dispatch" if _inject.straggle_targets_self() else "collective",
+            seconds,
+        )
+
+    def _note_device_attribution(self, trace_dir: str) -> None:
+        """A ``BAGUA_PROFILE_DIR`` auto-capture window just closed: parse
+        its xplane once and publish per-bucket device comm time + overlap
+        fraction (null-with-rationale on cpu-sim) into the obs summary /
+        exporter.  One-shot per window, exception-free, and OFF the
+        training step: a large model's xplane.pb can take seconds to
+        parse, which inline would stall a dispatch (and read as a
+        self-inflicted step anomaly) — a daemon thread publishes when
+        done.  The bucket launch schedule is harvested from the ring HERE
+        (cheap), not in the thread, so a concurrent recompile cannot skew
+        the match."""
+        from ..obs.attribution import bucket_launches_from_ring
+
+        try:
+            launches = bucket_launches_from_ring()
+        except Exception:  # noqa: BLE001
+            launches = []
+
+        def _parse():
+            try:
+                from ..obs import export as _obs_export
+                from ..obs.attribution import attribute_device_comm
+
+                record = attribute_device_comm(trace_dir,
+                                               bucket_launches=launches)
+                _obs_export.note_device_attribution(record)
+                if record.get("available"):
+                    logger.info(
+                        "device attribution: comm %.6fs/step, overlap "
+                        "%.1f%% (%s)", record.get("comm_s_per_step") or 0.0,
+                        100.0 * (record.get("overlap_fraction") or 0.0),
+                        trace_dir,
+                    )
+                else:
+                    logger.info("device attribution unavailable: %s",
+                                record.get("rationale"))
+            except Exception as e:  # noqa: BLE001
+                logger.warning("device attribution failed: %s", e)
+
+        threading.Thread(target=_parse, name="bagua-obs-attribution",
+                         daemon=True).start()
 
     def _note_step_cadence(self) -> None:
         now = time.monotonic()
         if self._last_step_mono is not None:
-            dt = now - self._last_step_mono - self._last_straggle_sleep
+            raw = now - self._last_step_mono
+            dt = raw - self._last_straggle_sleep
             if dt > 0:
                 self._step_dt = dt
+            if self.anomaly_detector is not None and raw > 0:
+                # the wall window that just closed belongs to the PREVIOUS
+                # step; its phase attributions were accumulated during it.
+                # A window that contained a compile or a state migration
+                # is skipped outright — an expected one-off stall must not
+                # flag (autotune retunes recompile every sample) nor enter
+                # the baseline.
+                phases, self._phase_durations = self._phase_durations, {}
+                if self._anomaly_skip_window:
+                    self._anomaly_skip_window = False
+                else:
+                    self.anomaly_detector.observe(
+                        self._step_counter - 1, raw, phases
+                    )
         self._last_step_mono = now
         if self._obs_enabled:
             # fleet view: the per-rank step/step-dt summary the health
@@ -1539,10 +1644,15 @@ class BaguaTrainer:
         # step synchronizes with every rank (per-step gradient collective);
         # async families pay at their own negotiated boundaries instead
         self._note_step_cadence()
+        if self._profiler is not None and self._obs_enabled:
+            closed = self._profiler.consume_closed_dir()
+            if closed:
+                self._note_device_attribution(closed)
         self._last_straggle_sleep = _inject.maybe_straggle(
             "step", base_dt=self._step_dt,
             gated=self.algorithm.straggler_gates_step,
         )
+        self._note_stall_phase(self._last_straggle_sleep)
         state = self.algorithm.host_pre_step(self, state)
         if self.algorithm.need_reset(self._step_counter - 1):
             self._phase += 1
@@ -1584,14 +1694,18 @@ class BaguaTrainer:
             # consumes it
             state = self._pending_state_migration(state)
             self._pending_state_migration = None
+            self._anomaly_skip_window = True
         fn = self._get_step_fn()
         # poison accounting reads the persisted state.step BEFORE dispatch:
         # the buffers are donated to fn, and the compiled fault fires on
         # state.step (which resumes from checkpoints), not the
         # trainer-local call counter
         self._note_traced_fault_fires(state)
+        _dispatch_t0 = time.monotonic()
         with trace_span("step/dispatch"):
             out = fn(state, batch)
+        self.note_phase_duration("dispatch",
+                                 time.monotonic() - _dispatch_t0)
         if self.grad_guard != "off":
             new_state, loss, health_vec = out
             self.step_metrics = {
@@ -1660,6 +1774,7 @@ class BaguaTrainer:
         # min over verdict rows (rank-uniform verdicts replicate; per-rank
         # gossip verdicts stack — this process acts on ALL its local rows,
         # so multi-device processes see every local replica's verdict)
+        _verdict_t0 = time.monotonic()
         with trace_span("step/grad_guard_verdict", step=step_no):
             if getattr(health_vec, "is_fully_addressable", True):
                 hv = np.asarray(health_vec)
@@ -1668,6 +1783,10 @@ class BaguaTrainer:
                     [np.asarray(s.data)
                      for s in health_vec.addressable_shards], axis=0
                 )
+        # the verdict readback is host optimizer-adjacent work: it blocks
+        # on the previous step's update having completed
+        self.note_phase_duration("optimizer",
+                                 time.monotonic() - _verdict_t0)
         hv = hv.min(axis=0)
         if self._obs_enabled:
             # host-safe mirror of the verdict: the flight recorder
@@ -2107,6 +2226,14 @@ class BaguaTrainer:
         # reflect only the current hyperparameter config
         speed = self._speed_tracker.get(now - self._last_report_time)
         self._last_report_time = now
+        # perf hints: anomaly detections since the last check-in ride
+        # along, so the scorer can tell "this config is slow" from
+        # "rank 5 got slow for environmental reasons" — tuning against
+        # the wrong one oscillates
+        from ..obs import anomaly as _obs_anomaly
+
+        hints = _obs_anomaly.drain_perf_hints()
+        hints_delivered = False
         try:
             if self._autotune_client is None:
                 self._autotune_client = get_hyperparameters_service_client()
@@ -2117,7 +2244,9 @@ class BaguaTrainer:
                 train_iter=self._step_counter,
                 hyperparameters=self._current_hyperparameters().model_dump(),
                 speed=speed,
+                perf_hints=hints or None,
             )
+            hints_delivered = True
             rsp = client.ask_hyperparameters(
                 model_name=self.model_name, rank=rank, train_iter=self._step_counter
             )
@@ -2126,6 +2255,10 @@ class BaguaTrainer:
             self._apply_recommendation(recommended)
             self._autotune_failures = 0
         except Exception as e:  # autotune must never take down training
+            if hints and not hints_delivered:
+                # a transient sidecar hiccup must not discard the taint
+                # signal — the next successful check-in carries it
+                _obs_anomaly.requeue_perf_hints(hints)
             self._autotune_failures += 1
             logger.warning("autotune check-in failed (%d/3): %s",
                            self._autotune_failures, e)

@@ -187,6 +187,24 @@ _declare("BAGUA_OBS_FLEET_OUT", "str", "",
          "every member's heartbeat health payload (per-rank step, "
          "staleness, skip counts, step-dt percentiles) into one atomic "
          "JSON.  Empty disables.")
+_declare("BAGUA_OBS_ANOMALY", "enum", "on",
+         "Step-time anomaly detector: rolling median/MAD baseline over "
+         "the raw host step cadence and per-phase durations; anomalies "
+         "count (`obs/step_anomalies`), trigger a throttled flight dump, "
+         "publish a `straggler_suspect` phase breakdown into the health "
+         "beacon, and feed perf hints to the autotune service.  Host-side "
+         "only (no effect on the compiled step); rides the BAGUA_OBS "
+         "master switch.",
+         choices=("on", "off"))
+_declare("BAGUA_OBS_ANOMALY_WINDOW", "int", "64",
+         "Rolling-baseline window (steps) of the step-time anomaly "
+         "detector.")
+_declare("BAGUA_OBS_ANOMALY_WARMUP", "int", "16",
+         "Baseline samples required before the anomaly detector may flag "
+         "(compile steps and cold caches must not poison the yardstick).")
+_declare("BAGUA_OBS_ANOMALY_THRESHOLD", "float", "5.0",
+         "Robust-z threshold (MAD multiples) a step's raw cadence must "
+         "exceed over the rolling median to count as anomalous.")
 _declare("BAGUA_ELASTIC_FENCE_UNHEALTHY", "int", "0",
          "Coordinator-side health fence: expel a member whose heartbeat "
          "health payload reports at least this many unhealthy events "
@@ -514,6 +532,24 @@ def get_obs_export_interval_s() -> float:
 def get_obs_fleet_out() -> Optional[str]:
     """Coordinator-side fleet snapshot path; None disables."""
     return _raw("BAGUA_OBS_FLEET_OUT")
+
+
+def get_obs_anomaly_mode() -> str:
+    """Step-time anomaly detector switch: ``on`` (default) or ``off``;
+    also off whenever the obs plane itself is off."""
+    return env_enum("BAGUA_OBS_ANOMALY")
+
+
+def get_obs_anomaly_window() -> int:
+    return env_int("BAGUA_OBS_ANOMALY_WINDOW")
+
+
+def get_obs_anomaly_warmup() -> int:
+    return env_int("BAGUA_OBS_ANOMALY_WARMUP")
+
+
+def get_obs_anomaly_threshold() -> float:
+    return env_float("BAGUA_OBS_ANOMALY_THRESHOLD")
 
 
 def get_elastic_store_addr() -> Optional[str]:

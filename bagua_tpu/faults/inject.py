@@ -463,6 +463,20 @@ def maybe_corrupt_checkpoint(directory, step: int) -> bool:
     return True
 
 
+def straggle_targets_self() -> bool:
+    """Whether an armed ``step.straggle`` spec names THIS process's rank —
+    i.e. injected stalls here model a locally slow host (the straggler
+    itself), not a wait on a slow peer.  The anomaly detector's phase
+    attribution reads this to file the stall under ``dispatch`` vs
+    ``collective``."""
+    plan = get_plan()
+    if plan is None:
+        return False
+    this_rank = _env.get_rank()
+    return any(s.rank == this_rank
+               for s in plan.armed_specs("step.straggle"))
+
+
 def maybe_straggle(sync_point: str, base_dt: Optional[float] = None,
                    gated: bool = True) -> float:
     """``step.straggle`` hook: stall the caller by ``(factor - 1)``× the

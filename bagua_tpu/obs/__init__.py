@@ -14,9 +14,23 @@ Three coupled pieces, instrumented into the real code paths:
   name, lint-enforced), the background metrics exporter
   (JSONL + Prometheus textfile), and the coordinator-side fleet snapshot.
 
+Plus the analysis layer on top of those signals:
+
+* :mod:`~bagua_tpu.obs.timeline` — merge per-rank span dumps into one
+  clock-aligned Perfetto/Chrome trace (``python -m bagua_tpu.obs.timeline``).
+* :mod:`~bagua_tpu.obs.anomaly` — rolling median/MAD step-time anomaly
+  detector: ``straggler_suspect`` phase breakdowns into the health beacon,
+  throttled flight dumps, perf hints for the autotune service.
+* :mod:`~bagua_tpu.obs.attribution` — device-time attribution: per-bucket
+  device comm seconds + overlap fraction from profiler xplanes
+  (null-with-rationale on cpu-sim).
+* :mod:`~bagua_tpu.obs.regress` — bench-trend sentinel against the
+  committed ``BENCH_*.json`` records (``python -m bagua_tpu.obs.regress``).
+
 Master switch: ``BAGUA_OBS`` (default on; ``off`` restores the exact
 pre-obs host behavior — the compiled step program is identical either way).
-Import-light: no jax anywhere in the package.
+Import-light: no jax anywhere in the package (``attribution``/``regress``
+import it lazily for parsing/probing only).
 """
 
 from .export import (  # noqa: F401
@@ -34,3 +48,7 @@ from .recorder import (  # noqa: F401
 # NOTE: the span ring instance is ``spans.recorder`` — deliberately NOT
 # re-exported here, where it would shadow the ``obs.recorder`` submodule
 from .spans import SpanRecorder, span_ring, trace_span  # noqa: F401
+from .anomaly import StepAnomalyDetector, fleet_straggler_suspects  # noqa: F401,E402
+# NOTE: obs.timeline and obs.regress are NOT imported here — both are
+# `python -m` entry points, and a package-level import would leave a
+# second copy of the module executing under runpy

@@ -1,0 +1,172 @@
+"""Device-time attribution: which bucket's collective costs what ON DEVICE.
+
+The host spans (PR 7) honestly time only the host; the device program is
+opaque to them — a bucket's ``trace/bucket_collective`` span documents the
+*launch schedule* at trace time, not where device microseconds go.  The
+profiler's xplane has the other half: per-occurrence device events for
+every XLA op, including the collectives (``all-reduce-start`` etc.).  This
+module joins the two:
+
+* host side — the overlap scheduler's per-bucket launch spans carry
+  ``bucket`` index and ``bytes``;
+* device side — :func:`bagua_tpu.profiling.parse_xplane_comm_events`
+  yields the communication occurrences in device-time order
+  (:func:`~bagua_tpu.profiling.is_comm_op` is the wire filter).
+
+When the trace's per-step comm occurrence count matches the bucket count,
+occurrences map to buckets positionally (the launch order IS the device
+issue order under XLA's in-order collective streams) and the report names
+per-bucket device comm seconds — the measured signal the ROADMAP's
+autotune-v2 bucket-size search scores against.  Otherwise (fused
+collectives, chunked rings multiplying occurrences) the report degrades to
+per-op aggregates, saying so.
+
+On cpu-sim there is no TPU plane — the report is
+``{"available": False, "rationale": ...}``, the same null-with-rationale
+convention as ``trace_overlap``: a number that measures nothing real is
+worse than an honest null.
+
+The trainer runs :func:`attribute_device_comm` once when a
+``BAGUA_PROFILE_DIR`` auto-capture window closes, publishes the summary
+gauges (``obs/device_comm_s_per_step``, ``obs/device_overlap_fraction``)
+and hands the record to :func:`bagua_tpu.obs.export.note_device_attribution`
+so it rides the per-rank obs summary → health beacon → fleet snapshot.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["attribute_device_comm", "bucket_launches_from_ring",
+           "UNAVAILABLE_RATIONALE"]
+
+UNAVAILABLE_RATIONALE = (
+    "trace has no TPU device plane or no communication ops — device-time "
+    "attribution needs real device events (cpu-sim collectives are "
+    "single-host memcpy); host spans still cover the dispatch side"
+)
+
+
+def bucket_launches_from_ring(spans: Optional[List[dict]] = None
+                              ) -> List[dict]:
+    """The newest per-bucket launch schedule from the span ring: one entry
+    per ``trace/bucket_collective`` span (deduped by bucket index, last
+    trace wins — a recompile re-records the schedule), sorted by launch
+    order.  ``[{"bucket", "bytes"}, ...]``; [] when the overlap scheduler
+    never ran (serialized path has one fused comm stage, not per-bucket
+    launches)."""
+    if spans is None:
+        from . import spans as _spans
+
+        spans = _spans.recorder.snapshot()
+    by_bucket: Dict[int, dict] = {}
+    for span in spans:
+        if span.get("name") != "trace/bucket_collective":
+            continue
+        attrs = span.get("attrs") or {}
+        if "bucket" not in attrs:
+            continue
+        by_bucket[int(attrs["bucket"])] = {
+            "bucket": int(attrs["bucket"]),
+            "bytes": int(attrs.get("bytes") or 0),
+            "t0": span.get("t0", 0.0),
+        }
+    out = sorted(by_bucket.values(), key=lambda e: e["t0"])
+    for e in out:
+        e.pop("t0", None)
+    return out
+
+
+def attribute_device_comm(log_dir: str,
+                          bucket_launches: Optional[List[dict]] = None
+                          ) -> dict:
+    """Attribute device communication time from a profiler trace directory.
+
+    Returns (always a dict, never raises):
+
+    * unavailable — ``{"available": False, "rationale": ...}``;
+    * available — ``{"available": True, "step_s", "comm_s_per_step",
+      "compute_s_per_step", "overlap_fraction", "per_bucket": [...] |
+      None, "per_bucket_rationale": ... when per_bucket is None,
+      "per_op": [...]}``.
+
+    ``per_bucket`` entries are ``{"bucket", "bytes", "device_comm_s"}``
+    (mean device seconds per step for that bucket's collective).
+    """
+    from .. import profiling as _prof
+
+    try:
+        newest = _prof._newest_xplane(log_dir)
+        if newest is None:
+            return {"available": False, "rationale": UNAVAILABLE_RATIONALE}
+        comm = _prof.parse_xplane_comm_events(newest)
+        overlap = _prof.parse_xplane_overlap(newest)
+    except Exception as e:  # noqa: BLE001 - proto availability varies
+        return {"available": False,
+                "rationale": f"xplane parse unavailable: {e}"}
+    if not comm or not comm.get("events"):
+        return {"available": False, "rationale": UNAVAILABLE_RATIONALE}
+
+    events = comm["events"]
+    n_steps = int(comm.get("n_steps") or 0)
+    record: dict = {"available": True}
+    if overlap:
+        record.update({
+            "step_s": overlap["step_s"],
+            "comm_s_per_step": overlap["comm_s_per_step"],
+            "compute_s_per_step": overlap["compute_s_per_step"],
+            "overlap_fraction": overlap["overlap_fraction"],
+        })
+    # per-op aggregate: always reportable (the -start half carries wire
+    # time; -done is the wait)
+    per_op: Dict[str, dict] = {}
+    for ev in events:
+        rec = per_op.setdefault(ev["name"],
+                                {"op": ev["name"], "time_s": 0.0,
+                                 "occurrences": 0})
+        rec["time_s"] += ev["dur_s"]
+        rec["occurrences"] += 1
+    for rec in per_op.values():
+        rec["time_s"] = round(rec["time_s"], 9)
+    record["per_op"] = sorted(per_op.values(),
+                              key=lambda r: -r["time_s"])
+
+    if bucket_launches is None:
+        bucket_launches = bucket_launches_from_ring()
+    record["per_bucket"] = None
+    n_buckets = len(bucket_launches)
+    if not n_buckets:
+        record["per_bucket_rationale"] = (
+            "no per-bucket launch spans in the ring (serialized comm "
+            "stage is one fused launch) — per-op totals above are the "
+            "attribution"
+        )
+        return record
+    # positional match: wire-time occurrences only (the -done waits say
+    # where the schedule stalled, not what the bucket cost).  XLA
+    # uniquifies instruction names, so the done halves appear as
+    # `all-reduce-done`, `all-reduce-done.1`, ... — match the infix, not
+    # the suffix
+    wire = [e for e in events if "-done" not in e["name"]]
+    if n_steps and len(wire) % n_steps == 0 \
+            and len(wire) // n_steps == n_buckets:
+        per_step = len(wire) // n_steps
+        totals = [0.0] * n_buckets
+        for i, ev in enumerate(wire):
+            totals[i % per_step] += ev["dur_s"]
+        record["per_bucket"] = [
+            {"bucket": launch["bucket"], "bytes": launch["bytes"],
+             "device_comm_s": round(totals[pos] / n_steps, 9)}
+            for pos, launch in enumerate(bucket_launches)
+        ]
+    else:
+        record["per_bucket_rationale"] = (
+            f"{len(wire)} device comm occurrences across "
+            f"{n_steps or '?'} steps do not map 1:1 onto {n_buckets} "
+            "bucket launches (fused or chunked collectives) — per-op "
+            "totals above are the attribution"
+        )
+    return record

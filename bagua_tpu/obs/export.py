@@ -48,8 +48,9 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "METRIC_REGISTRY", "Metric", "is_registered", "any_registered_matches",
     "MetricsExporter", "render_prometheus", "local_obs_summary",
-    "note_step", "note_step_metrics", "write_fleet_snapshot",
-    "validate_fleet_snapshot", "FLEET_SCHEMA",
+    "note_step", "note_step_metrics", "note_anomaly",
+    "note_device_attribution", "last_device_attribution",
+    "write_fleet_snapshot", "validate_fleet_snapshot", "FLEET_SCHEMA",
 ]
 
 
@@ -148,6 +149,24 @@ _declare("obs/flight_dumps", "counter",
          "Flight-recorder post-mortem dumps written.")
 _declare("obs/export_snapshots", "counter",
          "Metrics-exporter snapshots written (jsonl line + prom file).")
+_declare("obs/spans_dropped", "gauge",
+         "Spans evicted from this process's bounded span ring "
+         "(BAGUA_OBS_RING) — non-zero means a merged timeline's track is "
+         "a tail, not the whole run.")
+# -- step-time anomaly detection (docs/observability.md) --
+_declare("obs/step_anomalies", "counter",
+         "Steps flagged by the rolling median/MAD step-time anomaly "
+         "detector (raw host cadence far outside this rank's baseline).")
+_declare("obs/perf_hints", "counter",
+         "Perf hints published for the autotune service (anomaly "
+         "detections and other environmental performance signals).")
+# -- device-time attribution (profiler-derived, TPU only) --
+_declare("obs/device_comm_s_per_step", "gauge",
+         "Measured device communication seconds per step from the last "
+         "closed profiler window (null-with-rationale on cpu-sim).")
+_declare("obs/device_overlap_fraction", "gauge",
+         "Fraction of device comm time hidden under compute in the last "
+         "closed profiler window (parse_xplane_overlap).")
 
 
 def is_registered(name: str) -> bool:
@@ -195,6 +214,8 @@ _SUMMARY_LOCK = threading.Lock()
 _STEP_DTS: deque = deque(maxlen=64)
 _LAST_STEP: Optional[int] = None
 _LAST_STEP_METRICS: Dict[str, Any] = {}
+_LAST_ANOMALY: Optional[Dict[str, Any]] = None
+_LAST_DEVICE_ATTRIBUTION: Optional[Dict[str, Any]] = None
 
 
 def note_step(step: int, step_dt: Optional[float]) -> None:
@@ -221,6 +242,39 @@ def last_step_metrics() -> Dict[str, Any]:
         return dict(_LAST_STEP_METRICS)
 
 
+def note_anomaly(suspect: Dict[str, Any]) -> None:
+    """The anomaly detector's fleet-view hook: the latest
+    ``straggler_suspect`` rides the per-rank obs summary (beacon →
+    heartbeat → coordinator snapshot)."""
+    global _LAST_ANOMALY
+    with _SUMMARY_LOCK:
+        _LAST_ANOMALY = dict(suspect)
+
+
+def note_device_attribution(record: Dict[str, Any]) -> None:
+    """Publish a device-time attribution record
+    (:func:`bagua_tpu.obs.attribution.attribute_device_comm`): summary
+    gauges for the exporter, the full record for the obs summary.  An
+    unavailable record (cpu-sim) is kept too — null-with-rationale beats
+    silence."""
+    global _LAST_DEVICE_ATTRIBUTION
+    with _SUMMARY_LOCK:
+        _LAST_DEVICE_ATTRIBUTION = dict(record)
+    if record.get("available"):
+        if record.get("comm_s_per_step") is not None:
+            counters.set_gauge("obs/device_comm_s_per_step",
+                               float(record["comm_s_per_step"]))
+        if record.get("overlap_fraction") is not None:
+            counters.set_gauge("obs/device_overlap_fraction",
+                               float(record["overlap_fraction"]))
+
+
+def last_device_attribution() -> Optional[Dict[str, Any]]:
+    with _SUMMARY_LOCK:
+        return (dict(_LAST_DEVICE_ATTRIBUTION)
+                if _LAST_DEVICE_ATTRIBUTION is not None else None)
+
+
 def _percentile(sorted_vals: List[float], q: float) -> float:
     idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
     return sorted_vals[idx]
@@ -234,6 +288,9 @@ def local_obs_summary() -> Optional[dict]:
     with _SUMMARY_LOCK:
         step = _LAST_STEP
         dts = sorted(_STEP_DTS)
+        anomaly = dict(_LAST_ANOMALY) if _LAST_ANOMALY else None
+        attribution = (dict(_LAST_DEVICE_ATTRIBUTION)
+                       if _LAST_DEVICE_ATTRIBUTION else None)
     if step is None:
         return None
     summary = {
@@ -245,16 +302,33 @@ def local_obs_summary() -> Optional[dict]:
     if dts:
         summary["step_dt_p50"] = round(_percentile(dts, 0.5), 6)
         summary["step_dt_p90"] = round(_percentile(dts, 0.9), 6)
+    if anomaly:
+        # the fleet's straggler question, answered per rank: latest flagged
+        # step, how slow, and which phase dominated the excess
+        summary["straggler_suspect"] = anomaly
+    if attribution:
+        if attribution.get("available"):
+            summary["device_comm_s_per_step"] = attribution.get(
+                "comm_s_per_step")
+            summary["device_overlap_fraction"] = attribution.get(
+                "overlap_fraction")
+        else:
+            # null-with-rationale, like trace_overlap's bench records
+            summary["device_comm_s_per_step"] = None
+            summary["device_attribution_rationale"] = attribution.get(
+                "rationale")
     return summary
 
 
 def reset_local_summary() -> None:
     """Forget the per-rank summary (test isolation)."""
-    global _LAST_STEP
+    global _LAST_STEP, _LAST_ANOMALY, _LAST_DEVICE_ATTRIBUTION
     with _SUMMARY_LOCK:
         _LAST_STEP = None
         _STEP_DTS.clear()
         _LAST_STEP_METRICS.clear()
+        _LAST_ANOMALY = None
+        _LAST_DEVICE_ATTRIBUTION = None
 
 
 # ---- Prometheus / JSONL rendering -----------------------------------------
@@ -332,6 +406,11 @@ class MetricsExporter:
     def export_once(self) -> dict:
         """One snapshot (also the thread's body): returns the JSONL record
         for tests/round-trips."""
+        from . import spans as _spans
+
+        # ring drop pressure rides every snapshot: a truncated timeline
+        # must read as truncated, not as a quiet run
+        counters.set_gauge("obs/spans_dropped", _spans.recorder.dropped)
         snap = counters.snapshot()
         record: Dict[str, Any] = {
             "time_unix": time.time(),
@@ -345,6 +424,9 @@ class MetricsExporter:
         metrics = last_step_metrics()
         if metrics:
             record["step_metrics"] = metrics
+        attribution = last_device_attribution()
+        if attribution:
+            record["device_attribution"] = attribution
         trainer = self._trainer() if self._trainer is not None else None
         if trainer is not None:
             dt = getattr(trainer, "measured_step_dt", None)

@@ -1,0 +1,348 @@
+"""Bench-trend sentinel: is this tree slower than the committed record?
+
+The repo carries measured artifacts (``BENCH_FLAT.json``,
+``BENCH_OVERLAP.json``, ...) whose records were produced by the interleaved
+A/B best-of-trials protocol (``benchmarks/_ab.py``).  Nothing re-reads them
+after commit — a hot-path regression shows up only when someone happens to
+re-run a bench.  This module closes the loop: re-measure a small probe
+suite with the SAME measurement functions, compare record-by-record
+against the committed values, and write ``BENCH_TREND.json``
+(schema ``bagua-bench-trend-v1``).
+
+The comparison is **noise-bound-aware**, in the _ab.py sense: a committed
+record's ``per_trial_ratios`` spread is its own honesty statement about
+run-to-run variance, so the regression tolerance for that metric is at
+least that half-spread (never below ``--tolerance``, default 10% — the
+observed cpu-sim noise floor); a committed or fresh record flagged
+``noise_bound`` can only ever produce a ``noise_bound`` verdict, never a
+``regressed`` one.  Fewer probe trials than the committed run (3 vs 5)
+bias the fresh best-of LOW, i.e. toward false alarms — which is why the
+sentinel runs **advisory** in ``scripts/ci.sh`` (prints, writes the trend,
+exits 0); ``--strict`` turns regressions into a non-zero exit for operator
+use.
+
+CLI::
+
+    python -m bagua_tpu.obs.regress                 # quick probe vs BENCH_FLAT.json
+    python -m bagua_tpu.obs.regress --fresh f.json --against BENCH_FLAT.json
+    python -m bagua_tpu.obs.regress --strict --trials 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TREND_SCHEMA", "compare_records", "run_quick_probe",
+           "validate_bench_trend", "main"]
+
+TREND_SCHEMA = "bagua-bench-trend-v1"
+
+#: observed run-to-run variance floor of the cpu-sim throughput benches
+#: (BENCH_FLAT gate provenance records 0.88-1.13x across runs)
+DEFAULT_TOLERANCE = 0.10
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _half_spread(record: dict) -> float:
+    """Half the per-trial ratio spread of an _ab.py record — its own
+    measured noise band (0 when the record carries no trials)."""
+    ratios = record.get("per_trial_ratios")
+    if not isinstance(ratios, list) or len(ratios) < 2:
+        return 0.0
+    try:
+        return (max(ratios) - min(ratios)) / 2.0
+    except TypeError:
+        return 0.0
+
+
+def _is_higher_better(*records: dict) -> bool:
+    """Whether a record pair is a known HIGHER-is-better quantity: a
+    throughput record (unit carries a rate, ``.../s...``) or an _ab.py
+    speedup record (``per_trial_ratios``/``faster_path``).  Anything else
+    — compile times, HLO op-count ratios, byte counts — is skipped rather
+    than compared with an assumed direction: a lower-is-better metric
+    run through a higher-is-better comparison INVERTS the verdict, which
+    is worse than no verdict."""
+    for rec in records:
+        unit = rec.get("unit") or ""
+        if "/s" in unit:
+            return True
+        if "per_trial_ratios" in rec or "faster_path" in rec:
+            return True
+    return False
+
+
+def compare_records(fresh: Sequence[dict], committed: Sequence[dict],
+                    tolerance: float = DEFAULT_TOLERANCE) -> List[dict]:
+    """Per-metric fresh/committed comparison; returns one verdict dict per
+    metric present in BOTH with a positive numeric value and a KNOWN
+    direction (see :func:`_is_higher_better` — direction-unknown metrics
+    are skipped, never guessed).
+
+    Verdicts: ``ok`` (within tolerance), ``improved`` (above it),
+    ``regressed`` (below it, and neither side is noise-bound),
+    ``noise_bound`` (below it but either side's own trial spread says the
+    comparison cannot support a conclusion)."""
+    by_metric: Dict[str, dict] = {
+        r["metric"]: r for r in committed
+        if isinstance(r, dict) and r.get("metric")
+    }
+    out: List[dict] = []
+    for rec in fresh:
+        if not isinstance(rec, dict):
+            continue
+        name = rec.get("metric")
+        base = by_metric.get(name)
+        if base is None:
+            continue
+        fv, cv = rec.get("value"), base.get("value")
+        if not isinstance(fv, (int, float)) \
+                or not isinstance(cv, (int, float)) or cv <= 0 or fv <= 0:
+            continue
+        if not _is_higher_better(rec, base):
+            continue
+        ratio = fv / cv
+        tol = max(float(tolerance), _half_spread(base), _half_spread(rec))
+        noisy = bool(base.get("noise_bound") or rec.get("noise_bound"))
+        if ratio < 1.0 - tol:
+            verdict = "noise_bound" if noisy else "regressed"
+        elif ratio > 1.0 + tol:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        out.append({
+            "metric": name,
+            "fresh_value": fv,
+            "committed_value": cv,
+            "unit": rec.get("unit") or base.get("unit"),
+            "ratio": round(ratio, 3),
+            "tolerance": round(tol, 3),
+            "noise_bound": noisy,
+            "verdict": verdict,
+        })
+    return out
+
+
+def run_quick_probe(trials: int = 3) -> List[dict]:
+    """Re-measure the BENCH_FLAT headline config (gradient_allreduce,
+    accum 1, flat on vs off) with the SAME measurement function and
+    interleaved protocol the committed artifact used — smaller trial
+    count, recorded in the output's ``timing`` tags.
+
+    Runs in a SUBPROCESS pinned to the 8-device cpu-sim mesh: that is
+    where the committed cpu records were measured (a probe on a different
+    topology compares nothing), and by the time this module can act, the
+    importing process has usually initialized jax already —
+    ``JAX_PLATFORMS``/``XLA_FLAGS`` only bind before first device use.
+    The probe's own anomaly detector is disabled: an interleaved bench's
+    leg switches are not fleet anomalies."""
+    import subprocess
+
+    import re
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # force EXACTLY 8 devices: an inherited ...device_count=4 (local
+    # debugging) would otherwise survive a substring check and measure the
+    # wrong mesh against the committed 8-device records
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", "")).strip()
+    env["XLA_FLAGS"] = (flags + " "
+                        "--xla_force_host_platform_device_count=8").strip()
+    env["BAGUA_OBS_ANOMALY"] = "off"
+    proc = subprocess.run(
+        [sys.executable, "-m", "bagua_tpu.obs.regress", "--probe-only",
+         "--trials", str(int(trials))],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=1200,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"quick probe subprocess failed (rc {proc.returncode}): "
+            f"{proc.stderr[-2000:]}"
+        )
+    # records are the last line of stdout (the benches print progress)
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _run_probe_inprocess(trials: int) -> List[dict]:
+    """The subprocess half of :func:`run_quick_probe` — assumes the env
+    (cpu-sim topology) was set before jax initialized."""
+    import jax
+    import numpy as np
+
+    sys.path.insert(0, _REPO)
+    from benchmarks._ab import interleaved_ab, speedup_record
+    from benchmarks.flat_resident_bench import measure
+
+    if jax.devices()[0].platform != "cpu" or len(jax.devices()) != 8:
+        logger.warning(
+            "quick probe running on %d %s device(s), not the 8-dev "
+            "cpu-sim mesh the committed records used — expect no or "
+            "meaningless comparisons",
+            len(jax.devices()), jax.devices()[0].platform,
+        )
+    off, on, ratios = interleaved_ab(
+        lambda: measure("gradient_allreduce", 1, "off", repeats=1),
+        lambda: measure("gradient_allreduce", 1, "on", repeats=1),
+        trials=trials,
+    )
+    faster = "on" if float(np.median(ratios)) >= 1.0 else "off"
+    speedup = speedup_record(
+        "flat_speedup_gradient_allreduce_accum1", ratios, "flat/leaf",
+        faster_path=faster, platform=on["platform"],
+    )
+    return [off, on, speedup]
+
+
+def build_trend(comparisons: List[dict], mode: str,
+                against: Sequence[str], trials: Optional[int],
+                strict: bool) -> dict:
+    regressions = [c["metric"] for c in comparisons
+                   if c["verdict"] == "regressed"]
+    record = {
+        "schema": TREND_SCHEMA,
+        "time_unix": time.time(),
+        "mode": mode,
+        "against": list(against),
+        "advisory": not strict,
+        "tolerance_floor": DEFAULT_TOLERANCE,
+        "comparisons": comparisons,
+        "regressions": regressions,
+        "improved": [c["metric"] for c in comparisons
+                     if c["verdict"] == "improved"],
+        "noise_bound": [c["metric"] for c in comparisons
+                        if c["verdict"] == "noise_bound"],
+        "pass": not regressions,
+    }
+    if trials is not None:
+        record["probe_trials"] = trials
+    try:
+        import jax
+
+        record["platform"] = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 - file-vs-file mode needs no jax
+        record["platform"] = None
+    return record
+
+
+def validate_bench_trend(record: dict) -> List[str]:
+    """Schema problems with a BENCH_TREND.json ([] = valid) — the
+    ``test_bench_sanity`` gate."""
+    problems: List[str] = []
+    if not isinstance(record, dict):
+        return ["not a JSON object"]
+    if record.get("schema") != TREND_SCHEMA:
+        problems.append(f"schema != {TREND_SCHEMA}")
+    for key, typ in (("time_unix", (int, float)), ("comparisons", list),
+                     ("regressions", list), ("pass", bool),
+                     ("advisory", bool), ("against", list)):
+        if not isinstance(record.get(key), typ):
+            problems.append(f"missing/mistyped {key}")
+    for i, cmp_ in enumerate(record.get("comparisons") or []):
+        for key in ("metric", "fresh_value", "committed_value", "ratio",
+                    "tolerance", "verdict"):
+            if key not in cmp_:
+                problems.append(f"comparisons[{i}] missing {key}")
+                break
+        if cmp_.get("verdict") not in ("ok", "improved", "regressed",
+                                       "noise_bound"):
+            problems.append(
+                f"comparisons[{i}] bad verdict {cmp_.get('verdict')!r}")
+    if not (record.get("comparisons") or []):
+        problems.append("comparisons empty — the sentinel measured nothing")
+    return problems
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bagua_tpu.obs.regress",
+        description="Compare a fresh bench run against the committed "
+                    "BENCH_*.json records (noise-bound-aware) and write "
+                    "BENCH_TREND.json.",
+    )
+    ap.add_argument("--fresh", default=None,
+                    help="fresh bench records (JSON list); default: run "
+                         "the quick probe suite in-process")
+    ap.add_argument("--against", action="append", default=None,
+                    help="committed artifact(s) to compare against "
+                         "(default: BENCH_FLAT.json at the repo root); "
+                         "repeatable")
+    ap.add_argument("--out", default=os.path.join(_REPO, "BENCH_TREND.json"),
+                    help="trend artifact path (default: BENCH_TREND.json)")
+    ap.add_argument("--trials", type=int, default=3,
+                    help="quick-probe interleaved trials (default 3)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="regression tolerance floor (default 0.10)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on regressions (default: advisory exit 0)")
+    ap.add_argument("--probe-only", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: subprocess half
+    args = ap.parse_args(argv)
+
+    if args.probe_only:
+        records = _run_probe_inprocess(max(1, args.trials))
+        print(json.dumps(records))
+        return 0
+
+    against = args.against or [os.path.join(_REPO, "BENCH_FLAT.json")]
+    committed: List[dict] = []
+    for path in against:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read committed records {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        committed.extend(data if isinstance(data, list) else [data])
+
+    trials: Optional[int] = None
+    if args.fresh:
+        with open(args.fresh) as f:
+            fresh = json.load(f)
+        mode = "files"
+    else:
+        trials = max(1, args.trials)
+        print(f"running quick probe ({trials} interleaved trials)...",
+              flush=True)
+        fresh = run_quick_probe(trials=trials)
+        mode = "quick_probe"
+
+    comparisons = compare_records(fresh, committed,
+                                  tolerance=args.tolerance)
+    if not comparisons:
+        print("no comparable metrics between fresh and committed records",
+              file=sys.stderr)
+        return 2
+    record = build_trend(comparisons, mode, against, trials, args.strict)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    for c in comparisons:
+        print(f"  {c['verdict']:>11}  {c['metric']}: "
+              f"{c['fresh_value']} vs {c['committed_value']} "
+              f"(x{c['ratio']}, tol ±{c['tolerance']})")
+    n_reg = len(record["regressions"])
+    print(f"wrote {args.out}: {len(comparisons)} metric(s), "
+          f"{n_reg} regression(s), "
+          f"{len(record['noise_bound'])} noise-bound — "
+          f"{'PASS' if record['pass'] else 'REGRESSED'}"
+          f"{' (advisory)' if record['advisory'] else ''}")
+    if n_reg and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 from typing import Optional, Tuple
 
 logger = logging.getLogger(__name__)
@@ -94,6 +95,7 @@ class StepProfiler:
         self.stop = stop
         self._active = False
         self._done = False
+        self._closed_dir: Optional[str] = None
 
     @classmethod
     def from_env(cls) -> Optional["StepProfiler"]:
@@ -135,9 +137,17 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
+            self._closed_dir = self.log_dir
             if _TRACE_OWNER is self:
                 _TRACE_OWNER = None
             logger.info("profiler: trace written to %s", self.log_dir)
+
+    def consume_closed_dir(self) -> Optional[str]:
+        """The log dir of a JUST-closed trace window, once (None after the
+        first read, and until another window closes) — the trainer's hook
+        for post-trace analysis like device-time attribution."""
+        d, self._closed_dir = self._closed_dir, None
+        return d
 
 
 # ---------------------------------------------------------------------------
@@ -147,6 +157,37 @@ class StepProfiler:
 # traffic — XLA's cost model "bytes accessed" conflates them, which is why
 # cost-model hbm_util can read >1.0)
 # ---------------------------------------------------------------------------
+
+def _newest_xplane(log_dir: str) -> Optional[str]:
+    """The most recently WRITTEN ``*.xplane.pb`` under ``log_dir``.
+
+    jax names trace files by host+timestamp; a plain ``sorted(...)[-1]``
+    picks the lexicographically last one, which is not the newest once a
+    directory holds traces from more than one capture (different hosts, or
+    timestamp formats that don't sort) — order by mtime instead."""
+    import glob
+
+    files = glob.glob(log_dir + "/**/*.xplane.pb", recursive=True)
+    if not files:
+        return None
+    return max(files, key=lambda p: (os.path.getmtime(p), p))
+
+
+def _load_xspace(xplane_path: str):
+    """Parse one serialized ``XSpace`` proto — the load boilerplate every
+    xplane parser shares."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
+
+    xs = xplane_pb2.XSpace()
+    with open(xplane_path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def _first_tpu_plane(xs):
+    return next(
+        (p for p in xs.planes if p.name.startswith("/device:TPU")), None
+    )
 
 def trace_memory_traffic(run_step, steps: int = 5, log_dir=None,
                          finalize=None) -> dict:
@@ -164,12 +205,10 @@ def trace_memory_traffic(run_step, steps: int = 5, log_dir=None,
     step time); ``finalize`` runs once inside the trace to fence everything
     (e.g. a final-loss readback).
     """
-    import glob
+    import shutil
     import tempfile
 
     import jax
-
-    import shutil
 
     owned = log_dir is None
     d = log_dir or tempfile.mkdtemp(prefix="bagua_trace_")
@@ -179,11 +218,11 @@ def trace_memory_traffic(run_step, steps: int = 5, log_dir=None,
                 run_step()
             if finalize is not None:
                 finalize()
-        files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
-        if not files:
+        newest = _newest_xplane(d)
+        if newest is None:
             return {}
         try:
-            return parse_xplane_memory_traffic(files[-1])
+            return parse_xplane_memory_traffic(newest)
         except Exception as e:  # pragma: no cover - proto availability varies
             logger.info("xplane parse unavailable: %s", e)
             return {}
@@ -198,7 +237,6 @@ def trace_op_profile(run, log_dir=None, finalize=None) -> dict:
     kernel's on-device time and HBM traffic in isolation, where wall-clock
     timing would measure the host dispatch round-trip instead (on tunneled
     transports that is milliseconds against a microsecond kernel)."""
-    import glob
     import shutil
     import tempfile
 
@@ -211,11 +249,11 @@ def trace_op_profile(run, log_dir=None, finalize=None) -> dict:
             run()
             if finalize is not None:
                 finalize()
-        files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
-        if not files:
+        newest = _newest_xplane(d)
+        if newest is None:
             return {}
         try:
-            return parse_xplane_op_profile(files[-1])
+            return parse_xplane_op_profile(newest)
         except Exception as e:  # pragma: no cover - proto availability varies
             logger.info("xplane parse unavailable: %s", e)
             return {}
@@ -235,15 +273,9 @@ def parse_xplane_op_profile(xplane_path: str) -> dict:
     the totals over a trace window containing ONLY the kernel under test
     are that kernel's true device time/traffic, independent of host
     dispatch latency."""
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
     from xprof.protobuf import op_metrics_pb2  # noqa: PLC0415
 
-    xs = xplane_pb2.XSpace()
-    with open(xplane_path, "rb") as f:
-        xs.ParseFromString(f.read())
-    plane = next(
-        (p for p in xs.planes if p.name.startswith("/device:TPU")), None
-    )
+    plane = _first_tpu_plane(_load_xspace(xplane_path))
     if plane is None:
         return {}
     smd = plane.stat_metadata
@@ -308,14 +340,7 @@ def parse_xplane_overlap(xplane_path: str) -> dict:
     Returns ``{}`` off-TPU or when the trace lacks the needed lines —
     callers record ``overlap_fraction: null`` honestly instead of guessing.
     """
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
-
-    xs = xplane_pb2.XSpace()
-    with open(xplane_path, "rb") as f:
-        xs.ParseFromString(f.read())
-    plane = next(
-        (p for p in xs.planes if p.name.startswith("/device:TPU")), None
-    )
+    plane = _first_tpu_plane(_load_xspace(xplane_path))
     if plane is None:
         return {}
     emd = plane.event_metadata
@@ -352,7 +377,6 @@ def trace_overlap(run_step, steps: int = 5, finalize=None) -> dict:
     """Run ``run_step()`` under a trace and return
     :func:`parse_xplane_overlap`'s fields ({} off-TPU).  Same enqueue-only
     contract as :func:`trace_memory_traffic`."""
-    import glob
     import shutil
     import tempfile
 
@@ -365,16 +389,62 @@ def trace_overlap(run_step, steps: int = 5, finalize=None) -> dict:
                 run_step()
             if finalize is not None:
                 finalize()
-        files = glob.glob(d + "/**/*.xplane.pb", recursive=True)
-        if not files:
+        newest = _newest_xplane(d)
+        if newest is None:
             return {}
         try:
-            return parse_xplane_overlap(files[-1])
+            return parse_xplane_overlap(newest)
         except Exception as e:  # pragma: no cover - proto availability varies
             logger.info("xplane parse unavailable: %s", e)
             return {}
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+def parse_xplane_comm_events(xplane_path: str) -> dict:
+    """Per-occurrence communication events from the first TPU plane, in
+    device-time order — the device half of per-bucket comm attribution
+    (``bagua_tpu.obs.attribution`` matches these against the host's
+    ``trace/bucket_collective`` launch schedule).
+
+    Returns ``{}`` when the trace has no TPU plane or no comm ops;
+    otherwise::
+
+        {"events": [{"name", "t0_s", "dur_s"}, ...],   # sorted by t0_s
+         "n_steps": ..., "step_s": mean device step seconds}
+
+    ``-start``/``-done`` halves of one async collective both match
+    :func:`is_comm_op`; the ``-start`` op carries the wire time, the
+    ``-done`` is the wait — callers see both, named."""
+    plane = _first_tpu_plane(_load_xspace(xplane_path))
+    if plane is None:
+        return {}
+    emd = plane.event_metadata
+    events = []
+    n_steps = 0
+    step_ps = 0
+    for line in plane.lines:
+        if line.name == "Steps":
+            n_steps = len(line.events)
+            step_ps = sum(e.duration_ps for e in line.events)
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            name = emd[ev.metadata_id].name
+            if is_comm_op(name):
+                events.append({
+                    "name": name,
+                    "t0_s": ev.offset_ps / 1e12,
+                    "dur_s": ev.duration_ps / 1e12,
+                })
+    if not events:
+        return {}
+    events.sort(key=lambda e: e["t0_s"])
+    out = {"events": events}
+    if n_steps and step_ps:
+        out["n_steps"] = n_steps
+        out["step_s"] = step_ps / n_steps / 1e12
+    return out
 
 
 def parse_xplane_memory_traffic(xplane_path: str) -> dict:
@@ -387,15 +457,9 @@ def parse_xplane_memory_traffic(xplane_path: str) -> dict:
     **per-chip** figures (one chip's traffic), not totals.  That is the
     convention every bench record uses (``*_per_chip``); do not multiply by
     chip count without checking the sharding actually balances traffic."""
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: PLC0415
     from xprof.protobuf import op_metrics_pb2  # noqa: PLC0415
 
-    xs = xplane_pb2.XSpace()
-    with open(xplane_path, "rb") as f:
-        xs.ParseFromString(f.read())
-    plane = next(
-        (p for p in xs.planes if p.name.startswith("/device:TPU")), None
-    )
+    plane = _first_tpu_plane(_load_xspace(xplane_path))
     if plane is None:
         return {}
     smd = plane.stat_metadata

@@ -53,6 +53,17 @@ class _TaskState:
         self.iter_by_rank: Dict[int, int] = {}
         self.n_samples = 0
         self.completed = False
+        # perf hints from the workers' anomaly detectors (newest last,
+        # bounded): environmental slowness context for the scorer — a
+        # sample whose window carried a straggler_suspect hint scores the
+        # environment, not the hyperparameter point.  The watermark below
+        # is the MONOTONIC received count, never len(perf_hints): once the
+        # bounded list saturates, its length stops moving exactly when
+        # hints are most frequent
+        self.perf_hints: List[dict] = []
+        self.perf_hints_total = 0
+        self.sample_hint_mark = 0
+        self.sample_retried = False
         # per-round decision cache: every rank asking at the same train_iter
         # must receive the SAME recommendation, or the ranks' compiled SPMD
         # programs diverge and their collectives deadlock (trainers check in
@@ -113,6 +124,13 @@ class AutotuneService:
         task = self._task(req["model_name"])
         with task.lock:
             task.speed_by_rank[int(req["rank"])] = float(req["speed"])
+            for hint in req.get("perf_hints") or []:
+                if isinstance(hint, dict):
+                    task.perf_hints.append(
+                        {**hint, "reported_by": int(req["rank"])}
+                    )
+                    task.perf_hints_total += 1
+            del task.perf_hints[:-64]  # bounded: hints are context, not log
         return {"message": "ok"}
 
     def report_tensor_execution_order(self, req: dict) -> dict:
@@ -157,6 +175,10 @@ class AutotuneService:
         if self.autotune_level < 1 or task.completed:
             return self._reply(task)
         if now - task.first_ask_time < self.warmup_time_s:
+            # hints landing during warmup describe windows that were never
+            # going to be scored — absorb them, or the first real sampling
+            # window would always burn its one re-measure on stale noise
+            task.sample_hint_mark = task.perf_hints_total
             return self._reply(task)
         # confidence gate: the current point must have run long enough AND
         # every rank must have checked in past the point's start iteration,
@@ -168,6 +190,24 @@ class AutotuneService:
             it > task.sample_start_iter for it in task.iter_by_rank.values()
         )
         if not (all_ranks_in and long_enough):
+            return self._reply(task)
+        if task.perf_hints_total > task.sample_hint_mark \
+                and not task.sample_retried:
+            # the window carried anomaly hints (a straggler, an injected
+            # stall): its speed measures the environment, not the point —
+            # re-measure once before scoring.  One retry only, so a
+            # chronically noisy fleet still makes progress (the score is
+            # then honest about its environment).
+            logger.info(
+                "autotune[%s]: %d perf hint(s) during the sample window — "
+                "re-measuring this point before scoring",
+                task.model_name,
+                task.perf_hints_total - task.sample_hint_mark,
+            )
+            task.sample_hint_mark = task.perf_hints_total
+            task.sample_retried = True
+            task.sample_start_time = now
+            task.sample_start_iter = train_iter
             return self._reply(task)
         score = sum(task.speed_by_rank.values())
         task.manager.record_sample(train_iter, task.recommended, score)
@@ -191,6 +231,8 @@ class AutotuneService:
             task.recommended = next_hp
         task.sample_start_time = now
         task.sample_start_iter = train_iter
+        task.sample_hint_mark = task.perf_hints_total
+        task.sample_retried = False
         return self._reply(task)
 
     def _reply(self, task: _TaskState) -> dict:
@@ -326,15 +368,18 @@ class AutotuneClient:
     def report_metrics(
         self, model_name: str, rank: int, train_iter: int,
         hyperparameters: dict, speed: float,
+        perf_hints: Optional[List[dict]] = None,
     ) -> dict:
-        return self._post(
-            "report_metrics",
-            {
-                "model_name": model_name, "rank": rank,
-                "train_iter": train_iter,
-                "hyperparameters": hyperparameters, "speed": speed,
-            },
-        )
+        payload = {
+            "model_name": model_name, "rank": rank,
+            "train_iter": train_iter,
+            "hyperparameters": hyperparameters, "speed": speed,
+        }
+        if perf_hints:
+            # anomaly-detector hints (bagua_tpu.obs.anomaly): the sampling
+            # state machine re-measures a window these taint
+            payload["perf_hints"] = perf_hints
+        return self._post("report_metrics", payload)
 
     def ask_hyperparameters(self, model_name: str, rank: int, train_iter: int) -> dict:
         return self._post(

@@ -72,10 +72,27 @@ os.environ["BAGUA_COMM_TIMEOUT_S"] = "off"
 # FRESH directory — an inherited BAGUA_OBS_DUMP_DIR could hold stale
 # flight_*.json from a previous run, and a stale artifact satisfying a
 # drill's expectation would mask a broken recorder (the exact regression
-# this gate exists to catch)
-DUMP_DIR = os.environ["BAGUA_OBS_DUMP_DIR"] = tempfile.mkdtemp(
-    prefix="chaos_obs_"
-)
+# this gate exists to catch).  --dump-dir NAMES the fresh directory (the
+# CI timeline stage assembles a fleet trace from these dumps afterwards)
+# but must still be empty — it is parsed here, before jax imports, because
+# the env var must be set before any bagua module reads it.
+def _early_dump_dir():
+    d = None
+    for i, arg in enumerate(sys.argv):
+        if arg == "--dump-dir" and i + 1 < len(sys.argv):
+            d = sys.argv[i + 1]
+        elif arg.startswith("--dump-dir="):  # argparse's = form too
+            d = arg.split("=", 1)[1]
+    if d:
+        os.makedirs(d, exist_ok=True)
+        if os.listdir(d):
+            sys.exit(f"--dump-dir {d} is not empty — flight "
+                     "expectations need a fresh directory")
+        return d
+    return tempfile.mkdtemp(prefix="chaos_obs_")
+
+
+DUMP_DIR = os.environ["BAGUA_OBS_DUMP_DIR"] = _early_dump_dir()
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -112,7 +129,8 @@ FLIGHT_EXPECTATIONS = {
     "nan_grad_skip_loss_continuity": {"fault_point": "grad.poison"},
     "collective_hang_watchdog_recovery": {"fault_point": "collective.hang",
                                           "trigger": "watchdog_abort"},
-    "straggler_throughput_degrades": {"fault_point": "step.straggle"},
+    "straggler_throughput_degrades": {"fault_point": "step.straggle",
+                                      "trigger": "step_anomaly"},
     "async_partition_staleness_catchup": {"fault_point": "async.partition"},
     "health_fence_flight_record": {"trigger": "health_fence"},
 }
@@ -429,12 +447,75 @@ def _golden_trainer(algo, **kw):
     return t, s, t.shard_batch(batch)
 
 
-def drill_straggler_throughput():
+def _anomaly_leg(straggle_rank, sim_rank, base_ms, factor, tmp):
+    """One real trainer run for the straggler anomaly detector: clean
+    baseline steps, then an armed ``step.straggle`` window, on the async
+    family — its ``async/negotiate`` boundaries are both where a slow
+    peer gates this rank AND the anchor spans the fleet timeline aligns
+    on.  Returns the suspects flagged DURING the straggle window, the
+    health-beacon path (the worker half of the fleet view), and writes
+    this leg's span-ring slice to the dump dir as simulated rank
+    ``sim_rank``'s ring dump (``spans_rank<r>.json``) for the timeline
+    assembly."""
+    from bagua_tpu.algorithms import AsyncModelAverageAlgorithm
+    from bagua_tpu.elastic.membership import write_health_beacon
+    from bagua_tpu.obs import export as obs_export
+    from bagua_tpu.obs import spans as obs_spans
+
+    obs_export.reset_local_summary()
+
+    def _key(sp):
+        return (sp.get("name"), sp.get("t0"), sp.get("t1"),
+                sp.get("thread"))
+
+    ring_before = {_key(sp) for sp in obs_spans.recorder.snapshot()}
+    dropped_before = obs_spans.recorder.dropped
+    algo = AsyncModelAverageAlgorithm(warmup_steps=0, period_steps=4)
+    t, s, b = _golden_trainer(algo)
+    for _ in range(10):
+        s, _ = t.train_step(s, b)
+    straggle_start = t._step_counter
+    with fault_scope(FaultSpec("step.straggle", rank=straggle_rank,
+                               count=-1, base_ms=base_ms, factor=factor)):
+        for _ in range(6):
+            s, _ = t.train_step(s, b)
+    # one clean step so the LAST straggled window is observed too (the
+    # detector inspects each window when the next step opens)
+    s, _ = t.train_step(s, b)
+    s = algo.barrier(t, s)
+    suspects = [sp for sp in (t.anomaly_detector.suspects
+                              if t.anomaly_detector else [])
+                if sp["step"] >= straggle_start]
+    beacon = os.path.join(tmp, f"straggler_beacon.r{sim_rank}")
+    write_health_beacon(beacon)
+    # this leg's ring slice, relabeled as the simulated rank: both legs
+    # count steps from 1, so their async/negotiate boundary spans share
+    # (name, step) anchor keys — the timeline aligns leg B's clock window
+    # onto leg A's exactly the way a real fleet's blocking gather would
+    leg_spans = [dict(sp, rank=sim_rank)
+                 for sp in obs_spans.recorder.snapshot()
+                 if _key(sp) not in ring_before]
+    ring_dump = os.path.join(DUMP_DIR, f"spans_rank{sim_rank}.json")
+    with open(ring_dump, "w") as f:
+        json.dump({"rank": sim_rank, "spans": leg_spans,
+                   # the leg's REAL drop delta: a rotated ring means
+                   # leg_spans is a tail, and the timeline must say so
+                   "spans_dropped":
+                       obs_spans.recorder.dropped - dropped_before,
+                   "simulated": True}, f, indent=1)
+    return suspects, beacon
+
+
+def drill_straggler_throughput(tmp):
     """A 10× peer straggler gates every synchronous step: throughput
     degrades by roughly the dilation yet every step completes — while the
     async family under the SAME armed fault keeps its steps ungated and
     pays only at negotiated boundaries (the BENCH_STRAGGLER measurement
-    in miniature)."""
+    in miniature).  The anomaly detector must additionally flag the slow
+    window on BOTH sides of the fault — collective-dominant on the gated
+    peer, dispatch-dominant on the straggler itself — and the
+    coordinator-side fleet snapshot must name the straggling rank from
+    the ``straggler_suspect`` phase breakdowns riding the beacons."""
     from bagua_tpu.algorithms import (
         AsyncModelAverageAlgorithm,
         GradientAllReduceAlgorithm,
@@ -479,12 +560,123 @@ def drill_straggler_throughput():
         )
         if detected and recovered:
             inject.record_recovery("step.straggle")
-    return {"injected": True, "detected": bool(detected),
-            "recovered": bool(recovered),
+
+    # --- anomaly extension: the detector must flag the slow window on
+    # both sides of the fault and the fleet snapshot must NAME the
+    # straggling rank from the phase breakdowns ---
+    anomaly_env = {"BAGUA_OBS_ANOMALY_WARMUP": "4",
+                   "BAGUA_OBS_ANOMALY_WINDOW": "24"}
+    saved = {k: os.environ.get(k) for k in anomaly_env}
+    os.environ.update(anomaly_env)
+    try:
+        # this process as the gated PEER of straggling rank 1: the wait
+        # files under `collective`
+        victim_suspects, victim_beacon = _anomaly_leg(
+            1, 0, base_ms, factor, tmp)
+        # this process as the straggler ITSELF (spec names our rank): the
+        # local slowness files under `dispatch`
+        self_suspects, straggler_beacon = _anomaly_leg(
+            0, 1, base_ms, factor, tmp)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    victim_ok = bool(victim_suspects) and \
+        victim_suspects[-1]["dominant_phase"] == "collective"
+    self_ok = bool(self_suspects) and \
+        self_suspects[-1]["dominant_phase"] == "dispatch"
+
+    # both legs ran in THIS process (env rank 0); relabel the second
+    # beacon as simulated rank 1 — the identity is the only hand-made part
+    # of the fleet path below (beacons -> merged heartbeat payload ->
+    # tracker -> fleet snapshot -> straggler naming are all production)
+    fleet_ok = False
+    fleet_suspects = {}
+    if victim_ok and self_ok:
+        from bagua_tpu.elastic import membership as mb
+        from bagua_tpu.obs import export as obs_export
+        from bagua_tpu.obs.anomaly import fleet_straggler_suspects
+
+        rec = json.load(open(straggler_beacon))
+        rec["obs"]["rank"] = 1
+        rec["obs"]["straggler_suspect"]["rank"] = 1
+        with open(straggler_beacon, "w") as f:
+            json.dump(rec, f)
+        payload = mb.merged_health_source(
+            [victim_beacon, straggler_beacon])()
+        fleet_path = os.path.join(tmp, "straggler_fleet.json")
+        obs_export.write_fleet_snapshot(fleet_path, 0, {0: payload})
+        fleet = json.load(open(fleet_path))
+        fleet_suspects = fleet_straggler_suspects(fleet)
+        fleet_ok = (
+            not obs_export.validate_fleet_snapshot(fleet)
+            and [s["rank"] for s in fleet_suspects["stragglers"]] == [1]
+            and 0 in [s["rank"] for s in fleet_suspects["victims"]]
+        )
+
+    # the fleet timeline over the two legs' ring dumps: a schema-valid,
+    # CLOCK-ALIGNED multi-rank Perfetto trace whose anchors are the legs'
+    # shared async/negotiate boundary steps
+    timeline_verdict = {"assembled": False}
+    try:
+        from bagua_tpu.obs import timeline as obs_timeline
+
+        recs = obs_timeline.load_rank_records(
+            [os.path.join(DUMP_DIR, "spans_rank0.json"),
+             os.path.join(DUMP_DIR, "spans_rank1.json")])
+        trace = obs_timeline.assemble_timeline(recs)
+        problems = obs_timeline.validate_timeline(trace)
+        trace_path = os.path.join(tmp, "straggler_timeline.json")
+        with open(trace_path, "w") as f:
+            json.dump(trace, f)
+        meta = trace["metadata"]
+        timeline_verdict = {
+            "assembled": True,
+            "schema_valid": not problems,
+            "problems": problems[:5],
+            "ranks": sorted(meta["ranks"]),
+            "aligned": meta["aligned"],
+            "anchor_spans_rank1": meta["ranks"].get("1", {}).get(
+                "anchor_spans", 0),
+            "events": len(trace["traceEvents"]),
+        }
+    except Exception as e:  # noqa: BLE001 - verdict, not crash
+        timeline_verdict["error"] = f"{type(e).__name__}: {e}"
+    timeline_ok = (
+        timeline_verdict.get("schema_valid") is True
+        and timeline_verdict.get("aligned") is True
+        and timeline_verdict.get("ranks") == ["0", "1"]
+        and timeline_verdict.get("anchor_spans_rank1", 0) >= 2
+    )
+
+    return {"injected": True,
+            "detected": bool(detected and victim_ok and self_ok),
+            "recovered": bool(recovered and fleet_ok and timeline_ok),
+            "timeline": timeline_verdict,
+            "anomaly": {
+                "victim_flagged": victim_ok,
+                "victim_dominant_phase": (victim_suspects[-1]
+                                          ["dominant_phase"]
+                                          if victim_suspects else None),
+                "straggler_flagged": self_ok,
+                "straggler_dominant_phase": (self_suspects[-1]
+                                             ["dominant_phase"]
+                                             if self_suspects else None),
+                "fleet_names_straggler_rank": ([s["rank"] for s in
+                                                fleet_suspects.get(
+                                                    "stragglers", [])]
+                                               if fleet_suspects else []),
+                "fleet_ok": fleet_ok,
+            },
             "details": f"{steps} steps: clean {clean_dt:.2f}s, sync+straggle "
                        f"{sync_dt:.2f}s (all finite: {sync_ok == steps}), "
                        f"async+straggle {async_dt:.2f}s — async retained "
-                       f"{sync_dt / async_dt:.1f}x sync throughput"}
+                       f"{sync_dt / async_dt:.1f}x sync throughput; anomaly "
+                       f"detector flagged peer(collective)="
+                       f"{victim_ok} self(dispatch)={self_ok}, fleet named "
+                       f"rank 1: {fleet_ok}"}
 
 
 def drill_async_partition_catchup():
@@ -619,7 +811,19 @@ def main(argv=None):
     ap.add_argument("--out", default=None,
                     help="output path (default: CHAOS_DRILL.json for the "
                          "full matrix, none for --only subsets)")
+    ap.add_argument("--dump-dir", default=None,
+                    help="flight-recorder dump directory (must be empty; "
+                         "default: a fresh tempdir) — consumed before "
+                         "argparse so the env var precedes jax imports")
     args = ap.parse_args(argv)
+    if args.dump_dir and \
+            os.path.abspath(args.dump_dir) != os.path.abspath(DUMP_DIR):
+        # a programmatic main(argv=[... , "--dump-dir", d]) cannot be
+        # honored: the env var was consumed from sys.argv at import time,
+        # before jax — fail loudly instead of dumping into a tempdir the
+        # caller never looks at
+        ap.error(f"--dump-dir must appear on the PROCESS command line "
+                 f"(dumps already bound to {DUMP_DIR} at import)")
 
     t0 = time.time()
     tmp = tempfile.mkdtemp(prefix="chaos_drill_")
@@ -632,7 +836,8 @@ def main(argv=None):
         "nan_grad_skip_loss_continuity": drill_nan_grad_skip,
         "grad_guard_on_goldens_unchanged": drill_guard_on_goldens,
         "collective_hang_watchdog_recovery": drill_collective_hang,
-        "straggler_throughput_degrades": drill_straggler_throughput,
+        "straggler_throughput_degrades":
+            lambda: drill_straggler_throughput(tmp),
         "async_partition_staleness_catchup": drill_async_partition_catchup,
         "health_fence_flight_record": lambda: drill_health_fence(tmp),
     }

@@ -29,7 +29,26 @@ echo "=== obs smoke trace (flight recorder on one live drill) ==="
 # drill itself asserts its flight-recorder dump exists, schema-validates,
 # and names the firing fault point (exit code carries the verdict).  The
 # full-matrix CHAOS_DRILL.json is schema-gated in test_bench_sanity.py.
-python scripts/chaos_drill.py --only nan_grad_skip_loss_continuity
+OBS_TMP="$(mktemp -d)"
+python scripts/chaos_drill.py --only nan_grad_skip_loss_continuity \
+  --dump-dir "$OBS_TMP/dumps"
+
+echo "=== fleet timeline from the drill's flight dumps ==="
+# The dumps the smoke trace just wrote must assemble into a schema-valid,
+# clock-aligned Perfetto trace — the analysis layer's own end-to-end gate.
+python -m bagua_tpu.obs.timeline "$OBS_TMP/dumps" \
+  --out "$OBS_TMP/timeline.json" --check
+rm -rf "$OBS_TMP"
+
+echo "=== bench trend sentinel (advisory) ==="
+# Quick probe re-measured with the committed artifact's own protocol,
+# compared noise-bound-aware; refreshes BENCH_TREND.json (schema-gated in
+# test_bench_sanity.py).  Advisory: regressions print and are recorded in
+# the trend artifact, they do not fail CI — cpu-sim CI hosts are noisy and
+# the probe runs fewer trials than the committed record.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m bagua_tpu.obs.regress --out BENCH_TREND.json \
+  || echo "advisory: bench trend sentinel reported a problem (non-blocking)"
 
 echo "=== chaos fast subset (fault injection -> detection -> recovery) ==="
 # The deterministic slice of scripts/chaos_drill.py: every injection point

@@ -43,6 +43,10 @@ MODULES = [
     "bagua_tpu.obs.spans",
     "bagua_tpu.obs.recorder",
     "bagua_tpu.obs.export",
+    "bagua_tpu.obs.timeline",
+    "bagua_tpu.obs.anomaly",
+    "bagua_tpu.obs.attribution",
+    "bagua_tpu.obs.regress",
     "bagua_tpu.profiling",
     "bagua_tpu.parallel.mesh",
     "bagua_tpu.parallel.tensor_parallel",

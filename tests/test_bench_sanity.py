@@ -176,6 +176,61 @@ def test_chaos_drill_artifact_schema():
         assert counters.get(key, 0) >= 1, key
     # the flight recorder's own accounting (ISSUE 7)
     assert counters.get("obs/flight_dumps", 0) >= 1
+    # the anomaly-detector extension (ISSUE 9): the straggler drill must
+    # flag the slow window on BOTH sides of the fault — collective-
+    # dominant on the gated peer, dispatch-dominant on the straggler
+    # itself — and the fleet snapshot must name the straggling rank
+    anomaly = record["faults"]["straggler_throughput_degrades"]["anomaly"]
+    assert anomaly["victim_flagged"] is True, anomaly
+    assert anomaly["victim_dominant_phase"] == "collective", anomaly
+    assert anomaly["straggler_flagged"] is True, anomaly
+    assert anomaly["straggler_dominant_phase"] == "dispatch", anomaly
+    assert anomaly["fleet_names_straggler_rank"] == [1], anomaly
+    assert anomaly["fleet_ok"] is True, anomaly
+    assert counters.get("obs/step_anomalies", 0) >= 2
+    straggler_flight = record["faults"]["straggler_throughput_degrades"][
+        "flight_record"]
+    assert straggler_flight["trigger"] == "step_anomaly", straggler_flight
+    # and the fleet timeline assembled from the two legs' ring dumps is a
+    # schema-valid, clock-aligned 2-rank Perfetto trace (anchored on the
+    # legs' shared async/negotiate boundary steps)
+    timeline = record["faults"]["straggler_throughput_degrades"]["timeline"]
+    assert timeline["schema_valid"] is True, timeline
+    assert timeline["aligned"] is True, timeline
+    assert timeline["ranks"] == ["0", "1"], timeline
+    assert timeline["anchor_spans_rank1"] >= 2, timeline
+
+
+def test_bench_trend_artifact_schema():
+    """BENCH_TREND.json (driver-visible artifact of
+    `python -m bagua_tpu.obs.regress`): the committed trend record must be
+    schema-valid with every comparison carrying a verdict and the
+    noise-bound honesty fields — the sentinel's output can't rot into an
+    unreadable shape while ci.sh runs it advisory."""
+    import json
+    import os
+
+    from bagua_tpu.obs.regress import validate_bench_trend
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "BENCH_TREND.json")
+    assert os.path.exists(path), "run python -m bagua_tpu.obs.regress first"
+    record = json.load(open(path))
+    assert validate_bench_trend(record) == [], validate_bench_trend(record)
+    assert record["mode"] in ("quick_probe", "files")
+    # the quick probe compares the BENCH_FLAT headline config; its paired
+    # speedup comparison must be present and carry the tolerance the
+    # committed record's own trial spread dictated
+    metrics = {c["metric"] for c in record["comparisons"]}
+    assert "flat_speedup_gradient_allreduce_accum1" in metrics
+    for c in record["comparisons"]:
+        assert c["tolerance"] >= 0.10 - 1e-9, c
+        assert isinstance(c["noise_bound"], bool), c
+    # advisory contract: a regression verdict is recorded, never hidden
+    assert set(record["regressions"]) == {
+        c["metric"] for c in record["comparisons"]
+        if c["verdict"] == "regressed"
+    }
 
 
 def test_straggler_bench_artifact_schema():

@@ -1,0 +1,114 @@
+"""Bench-trend sentinel (ISSUE 9): noise-bound-aware comparison against
+committed BENCH_*.json records, trend schema, CLI file mode."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bagua_tpu.obs import regress  # noqa: E402
+
+
+def test_compare_verdicts():
+    committed = [
+        {"metric": "thr_ok", "value": 100.0, "unit": "samples/s/chip"},
+        {"metric": "thr_bad", "value": 100.0, "unit": "img/s/chip"},
+        {"metric": "thr_up", "value": 100.0, "unit": "samples/s"},
+        {"metric": "speed_noisy", "value": 1.05,
+         "per_trial_ratios": [0.85, 1.1, 1.2], "noise_bound": True},
+        {"metric": "no_fresh_side", "value": 9.0, "unit": "samples/s"},
+        {"metric": "non_numeric", "value": None, "unit": "samples/s"},
+    ]
+    fresh = [
+        {"metric": "thr_ok", "value": 95.0},
+        {"metric": "thr_bad", "value": 70.0},
+        {"metric": "thr_up", "value": 130.0},
+        {"metric": "speed_noisy", "value": 0.7},
+        {"metric": "non_numeric", "value": 1.0},
+        {"metric": "unknown", "value": 1.0},
+    ]
+    out = {c["metric"]: c for c in regress.compare_records(fresh, committed)}
+    assert set(out) == {"thr_ok", "thr_bad", "thr_up", "speed_noisy"}
+    assert out["thr_ok"]["verdict"] == "ok"
+    assert out["thr_bad"]["verdict"] == "regressed"
+    assert out["thr_bad"]["ratio"] == 0.7
+    assert out["thr_up"]["verdict"] == "improved"
+    # a noise_bound committed record can never convict: verdict stays
+    # noise_bound even at a big drop, and its tolerance widened to its own
+    # per-trial half-spread
+    assert out["speed_noisy"]["verdict"] == "noise_bound"
+    assert out["speed_noisy"]["tolerance"] >= (1.2 - 0.85) / 2
+
+
+def test_direction_unknown_metrics_skipped_not_guessed():
+    """A lower-is-better record (HLO op-count ratio, compile seconds) run
+    through a higher-is-better comparison would INVERT the verdict —
+    direction-unknown metrics are skipped instead."""
+    committed = [
+        {"metric": "flat_fused_adam_hlo_op_ratio", "value": 0.919,
+         "unit": "x (flat/leaf StableHLO op count, fused-adam step)"},
+        {"metric": "compile_s", "value": 3.0, "unit": "seconds"},
+        {"metric": "thr", "value": 100.0, "unit": "samples/s"},
+    ]
+    fresh = [
+        {"metric": "flat_fused_adam_hlo_op_ratio", "value": 1.2},
+        {"metric": "compile_s", "value": 9.0},
+        {"metric": "thr", "value": 100.0},
+    ]
+    out = regress.compare_records(fresh, committed)
+    assert [c["metric"] for c in out] == ["thr"]
+
+
+def test_spread_widens_tolerance_without_noise_flag():
+    committed = [{"metric": "m", "value": 100.0,
+                  "per_trial_ratios": [0.8, 1.2]}]  # half-spread 0.2
+    fresh = [{"metric": "m", "value": 85.0}]
+    (c,) = regress.compare_records(fresh, committed)
+    assert c["verdict"] == "ok"            # 0.85 >= 1 - 0.2
+    fresh = [{"metric": "m", "value": 70.0}]
+    (c,) = regress.compare_records(fresh, committed)
+    assert c["verdict"] == "regressed"     # below even the widened band
+
+
+def test_trend_schema_roundtrip():
+    comparisons = regress.compare_records(
+        [{"metric": "m", "value": 50.0}],
+        [{"metric": "m", "value": 100.0, "unit": "samples/s"}],
+    )
+    rec = regress.build_trend(comparisons, "files", ["BENCH_X.json"],
+                              trials=None, strict=False)
+    assert regress.validate_bench_trend(rec) == []
+    assert rec["pass"] is False and rec["regressions"] == ["m"]
+    assert rec["advisory"] is True
+    # malformed records are named
+    assert regress.validate_bench_trend({}) != []
+    bad = dict(rec)
+    bad["comparisons"] = [{"metric": "m"}]
+    assert any("missing" in p for p in regress.validate_bench_trend(bad))
+
+
+def test_cli_file_mode(tmp_path):
+    fresh = tmp_path / "fresh.json"
+    committed = tmp_path / "committed.json"
+    out = tmp_path / "trend.json"
+    json.dump([{"metric": "m", "value": 96.0, "unit": "samples/s"}],
+              open(fresh, "w"))
+    json.dump([{"metric": "m", "value": 100.0, "unit": "samples/s"}],
+              open(committed, "w"))
+    rc = regress.main(["--fresh", str(fresh), "--against", str(committed),
+                       "--out", str(out)])
+    assert rc == 0
+    rec = json.load(open(out))
+    assert regress.validate_bench_trend(rec) == []
+    assert rec["mode"] == "files" and rec["pass"] is True
+    # strict mode turns a regression into a non-zero exit
+    json.dump([{"metric": "m", "value": 10.0}], open(fresh, "w"))
+    assert regress.main(["--fresh", str(fresh), "--against", str(committed),
+                         "--out", str(out), "--strict"]) == 1
+    assert regress.main(["--fresh", str(fresh), "--against", str(committed),
+                         "--out", str(out)]) == 0   # advisory default
+    # disjoint metrics -> usage error
+    json.dump([{"metric": "zzz", "value": 1.0}], open(fresh, "w"))
+    assert regress.main(["--fresh", str(fresh), "--against", str(committed),
+                         "--out", str(out)]) == 2

@@ -1,0 +1,178 @@
+"""Fleet timeline assembly (ISSUE 9): per-rank span dumps merge into one
+spec-valid Chrome-trace JSON, with cross-rank clock alignment anchored on
+shared boundary spans and nesting preserved per thread track."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bagua_tpu.obs import spans as obs_spans  # noqa: E402
+from bagua_tpu.obs import timeline as tl  # noqa: E402
+
+
+def _span(name, t0, t1, rank, step=None, depth=0, thread="MainThread",
+          attrs=None, error=None):
+    s = {"name": name, "t0": t0, "t1": t1, "dur_s": t1 - t0, "rank": rank,
+         "step": step, "depth": depth, "thread": thread}
+    if attrs:
+        s["attrs"] = attrs
+    if error:
+        s["error"] = error
+    return s
+
+
+def _rank_ring(rank, base):
+    """A synthetic rank's ring, in the rank's PRIVATE monotonic clock
+    (epoch ``base``).  True-time geometry per step: rank 1 dispatches 5ms
+    late and reaches the boundary later, but both ranks EXIT the blocking
+    ``async/negotiate`` gather at the same true instant — the anchor
+    premise the aligner leans on."""
+    spans = []
+    for i in range(3):
+        t = base + i * 0.100
+        lag = 0.005 * rank
+        spans.append(_span("step/dispatch", t + lag, t + lag + 0.040,
+                           rank, step=i))
+        # nested child fully inside the dispatch window
+        spans.append(_span("trace/bucket_collective",
+                           t + lag + 0.010, t + lag + 0.020,
+                           rank, step=i, depth=1,
+                           attrs={"bucket": 0, "bytes": 1024}))
+        spans.append(_span("async/negotiate", t + lag + 0.050, t + 0.080,
+                           rank, step=i, attrs={"launched": i,
+                                                "applied": i}))
+    return spans
+
+
+# two ranks whose monotonic epochs differ by 6200 s — raw t0s are hours
+# apart while the events interleave in true time
+R0 = {"rank": 0, "spans": _rank_ring(0, 1000.0), "spans_dropped": 0}
+R1 = {"rank": 1, "spans": _rank_ring(1, 7200.0),
+      "spans_dropped": 5,
+      "active_spans": [{"name": "watchdog/wedged", "t0": 7200.4, "rank": 1,
+                        "step": 2, "depth": 0, "thread": "waiter"}]}
+
+
+def test_two_rank_merge_aligns_skewed_clocks():
+    trace = tl.assemble_timeline([R0, R1])
+    assert tl.validate_timeline(trace) == []
+    meta = trace["metadata"]
+    assert meta["schema"] == "bagua-obs-timeline-v1"
+    assert meta["aligned"] is True
+    # rank 1's offset maps its epoch onto rank 0's: -(7200-1000) exactly
+    assert meta["ranks"]["1"]["anchor_spans"] == 3
+    assert abs(meta["ranks"]["1"]["clock_offset_s"] - (-6200.0)) < 1e-6
+    # after alignment, rank 1's step-0 dispatch starts exactly its 5ms
+    # true-time lag after rank 0's, and both boundary exits coincide
+    disp = {ev["pid"]: ev for ev in trace["traceEvents"]
+            if ev["ph"] == "X" and ev["name"] == "step/dispatch"
+            and ev["args"].get("step") == 0}
+    assert abs(disp[1]["ts"] - disp[0]["ts"] - 5e3) < 1.0
+    neg = {ev["pid"]: ev for ev in trace["traceEvents"]
+           if ev["ph"] == "X" and ev["name"] == "async/negotiate"
+           and ev["args"].get("step") == 0}
+    end0 = neg[0]["ts"] + neg[0]["dur"]
+    end1 = neg[1]["ts"] + neg[1]["dur"]
+    assert abs(end0 - end1) < 1.0
+
+
+def test_nesting_and_process_metadata_preserved():
+    trace = tl.assemble_timeline([R0, R1])
+    events = trace["traceEvents"]
+    # rank -> process: metadata names each pid "rank N"
+    names = {ev["pid"]: ev["args"]["name"] for ev in events
+             if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    # nesting: every bucket_collective X event lies inside its step's
+    # dispatch window on the same (pid, tid) track
+    for rank in (0, 1):
+        disp = {ev["args"]["step"]: ev for ev in events
+                if ev["ph"] == "X" and ev["pid"] == rank
+                and ev["name"] == "step/dispatch"}
+        kids = [ev for ev in events if ev["ph"] == "X" and ev["pid"] == rank
+                and ev["name"] == "trace/bucket_collective"]
+        assert len(kids) == 3
+        for kid in kids:
+            parent = disp[kid["args"]["step"]]
+            assert parent["tid"] == kid["tid"]
+            assert parent["ts"] <= kid["ts"]
+            assert kid["ts"] + kid["dur"] <= parent["ts"] + parent["dur"]
+
+
+def test_active_spans_become_begin_events_and_drops_surface():
+    trace = tl.assemble_timeline([R0, R1])
+    opens = [ev for ev in trace["traceEvents"] if ev["ph"] == "B"]
+    assert len(opens) == 1
+    assert opens[0]["name"] == "watchdog/wedged"
+    assert opens[0]["pid"] == 1
+    assert opens[0]["args"]["unfinished"] is True
+    # the satellite: a truncated ring reads as truncated in the metadata
+    assert trace["metadata"]["ranks"]["1"]["spans_dropped"] == 5
+    assert trace["metadata"]["ranks"]["0"]["spans_dropped"] == 0
+
+
+def test_unanchored_rank_flagged_not_silently_aligned():
+    lone = {"rank": 2,
+            "spans": [_span("step/dispatch", 50.0, 50.05, 2, step=0)],
+            "spans_dropped": 0}
+    trace = tl.assemble_timeline([R0, lone])
+    meta = trace["metadata"]
+    assert meta["aligned"] is False
+    assert meta["ranks"]["2"]["aligned"] is False
+    assert meta["ranks"]["2"]["anchor_spans"] == 0
+    assert tl.validate_timeline(trace) == []
+
+
+def test_duplicate_dumps_dedupe_and_empty_raises():
+    trace = tl.assemble_timeline([R0, R0, R1])
+    n0 = trace["metadata"]["ranks"]["0"]["spans"]
+    assert n0 == len(R0["spans"])  # the second identical dump adds nothing
+    with pytest.raises(ValueError):
+        tl.assemble_timeline([{"rank": 0, "spans": []}])
+
+
+def test_validate_rejects_malformed():
+    assert tl.validate_timeline({}) != []
+    bad = tl.assemble_timeline([R0])
+    bad["traceEvents"].append({"ph": "X", "name": "no-ts", "pid": 0})
+    assert any("X needs" in p for p in tl.validate_timeline(bad))
+
+
+def test_cli_end_to_end(tmp_path):
+    """Flight-dump-shaped files on disk -> CLI -> schema-valid trace file;
+    --check exercises the CI stage's exact path."""
+    d = tmp_path / "dumps"
+    d.mkdir()
+    for rec, name in ((R0, "flight_fault_fire_rank0_pid1.json"),
+                      (R1, "spans_rank1.json")):
+        with open(d / name, "w") as f:
+            json.dump(rec, f)
+    out = tmp_path / "timeline.json"
+    assert tl.main([str(d), "--out", str(out), "--check"]) == 0
+    trace = json.load(open(out))
+    assert tl.validate_timeline(trace) == []
+    assert set(trace["metadata"]["ranks"]) == {"0", "1"}
+    # no dumps -> usage error, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert tl.main([str(empty), "--out", str(out)]) == 2
+
+
+def test_dump_span_ring_roundtrip(tmp_path):
+    obs_spans.set_enabled(True)
+    obs_spans.recorder.clear()
+    try:
+        with obs_spans.trace_span("step/dispatch", step=1):
+            pass
+        path = tl.dump_span_ring(str(tmp_path / "spans_live.json"), rank=3)
+    finally:
+        obs_spans.recorder.clear()
+        obs_spans.set_enabled(None)
+    rec = json.load(open(path))
+    assert rec["rank"] == 3 and rec["spans"][0]["name"] == "step/dispatch"
+    trace = tl.assemble_timeline([rec])
+    assert tl.validate_timeline(trace) == []
