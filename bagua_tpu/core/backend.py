@@ -433,6 +433,18 @@ class BaguaTrainer:
         self._plan: Optional[BucketPlan] = None
         self._named_params = None
         self._step_cache: Dict[Any, Callable] = {}
+        #: XLA cost/memory model results cached per step-cache key —
+        #: ``step_cost_analysis`` re-lowered and re-queried on EVERY call
+        #: before this cache existed, which the ledger's per-step MFU gauge
+        #: would have paid every step
+        self._cost_analysis_cache: Dict[Any, Dict[str, Any]] = {}
+        self._memory_analysis_cache: Dict[Any, Optional[Dict[str, int]]] = {}
+        #: key -> threading.Event for cost/memory analyses a background
+        #: harvest thread is computing (the per-step MFU path must not pay
+        #: an inline lower+compile on the dispatch hot path; a concurrent
+        #: synchronous caller joins the harvest instead of re-compiling)
+        self._cost_analysis_pending: Dict[Any, threading.Event] = {}
+        self._current_step_key: Optional[Tuple] = None
         self._step_counter = 0
         self._phase = 0
 
@@ -480,12 +492,34 @@ class BaguaTrainer:
         # the exact pre-obs host behavior
         self._obs_enabled = _obs_spans.enabled()
         self._last_beacon_write = 0.0
+        #: goodput ledger (docs/observability.md, efficiency plane): every
+        #: wall-clock second of this process lands in exactly one class —
+        #: fed from the step-cadence windows, the span hook, stall reports,
+        #: and the grad guard's rewind verdicts below.  All host-side.
+        self._ledger = None
+        #: MFU denominator: peak silicon FLOP/s for this chip kind (None on
+        #: cpu-sim / unknown silicon -> obs/mfu stays null-with-rationale)
+        self._peak_flops = None
+        self._mfu_flops: Optional[float] = None
+        self._mfu_noted_unavailable = False
+        #: the CURRENT step's wall window contained a compile or state
+        #: migration: the cadence hook attributes the window there instead
+        #: of productive_step (the ledger mirror of _skip_next_speed_sample)
+        self._ledger_window_class: Optional[str] = None
+        self._footprint_noted = False
+        self._mem_poll_dead = False
+        self._mem_poll_failures = 0
         if self._obs_enabled:
             from ..obs import export as _obs_export
+            from ..obs import ledger as _obs_ledger
             from ..obs import recorder as _obs_recorder
 
             _obs_export.maybe_start_global_exporter(self)
             _obs_recorder.maybe_install_signal_hook()
+            self._ledger = _obs_ledger.install()
+            self._peak_flops = _obs_ledger.peak_flops_for_device_kind(
+                jax.devices()[0].device_kind
+            )
         #: step-time anomaly detector (docs/observability.md): rolling
         #: median/MAD baseline over the RAW host cadence (injected stalls
         #: included — a stall IS the anomaly an operator wants flagged,
@@ -1477,11 +1511,14 @@ class BaguaTrainer:
         named.update(zp["local"])
         return tree_from_named(self._param_template, named)
 
-    def _get_step_fn(self):
+    def _step_key(self) -> Tuple:
+        """The step-cache key for the CURRENT configuration — also keys the
+        cost/memory-analysis caches (one XLA cost-model query per compiled
+        program, not per call)."""
         from ..faults import inject as _inject
 
         overlap = self._overlap_active()
-        key = (
+        return (
             self._plan.signature(),
             self._phase,
             self.algorithm.hierarchical,
@@ -1503,18 +1540,24 @@ class BaguaTrainer:
             # reads it as key[-1]
             self.algorithm.compile_key(),
         )
+
+    def _get_step_fn(self):
+        key = self._step_key()
+        self._current_step_key = key
         if key not in self._step_cache:
             logger.info("bagua_tpu: compiling train step (phase=%s, %d buckets)",
                         self._phase, len(self._plan.buckets))
             with trace_span("step/build", phase=self._phase,
                             buckets=len(self._plan.buckets),
-                            overlap=overlap):
+                            overlap=self._overlap_active()):
                 self._step_cache[key] = self._make_step_fn(self._plan)
             # the step that triggers this compile produces a garbage-slow
             # speed sample; _auto_record_speed drops it — and the anomaly
-            # detector skips the window for the same reason
+            # detector skips the window, and the goodput ledger attributes
+            # it to `compile`, for the same reason
             self._skip_next_speed_sample = True
             self._anomaly_skip_window = True
+            self._ledger_window_class = "compile"
         return self._step_cache[key]
 
     def measured_step_dt(self) -> Optional[float]:
@@ -1531,6 +1574,8 @@ class BaguaTrainer:
         cadence sample subtracts it — see :meth:`measured_step_dt`."""
         self._last_straggle_sleep += float(seconds)
         self._note_stall_phase(seconds)
+        if self._ledger is not None and seconds > 0:
+            self._ledger.note_class_window("stall", float(seconds))
 
     def note_phase_duration(self, phase: str, seconds: float) -> None:
         """Attribute host seconds of the current step to a phase
@@ -1606,6 +1651,26 @@ class BaguaTrainer:
             dt = raw - self._last_straggle_sleep
             if dt > 0:
                 self._step_dt = dt
+            window_cls = None
+            if self._ledger is not None and raw > 0:
+                # goodput ledger: the wall window that just closed belongs
+                # to the previous step; class windows noted inside it
+                # (checkpoint, async boundaries, stalls) were already
+                # deducted by the ledger.  The remainder is productive-step
+                # time — unless the window contained a trace+compile or a
+                # state migration (XLA compiles lazily on first dispatch,
+                # so the build span alone under-counts): then the whole
+                # remainder is that class's wall, mirroring
+                # _skip_next_speed_sample.
+                window_cls = self._ledger_window_class or "productive_step"
+                self._ledger_window_class = None
+                self._ledger.note_step_window(
+                    self._step_counter - 1, raw, window_cls)
+            if window_cls in (None, "productive_step"):
+                # MFU only from productive windows: a compile/migration
+                # window's dt would publish a garbage-low sample that
+                # rides the beacon to the fleet view
+                self._maybe_note_mfu()
             if self.anomaly_detector is not None and raw > 0:
                 # the wall window that just closed belongs to the PREVIOUS
                 # step; its phase attributions were accumulated during it.
@@ -1627,6 +1692,154 @@ class BaguaTrainer:
             from ..obs import export as _obs_export
 
             _obs_export.note_step(self._step_counter, self._step_dt)
+
+    def _maybe_note_mfu(self) -> None:
+        """Per-step MFU gauge: the cached cost-model flops of the current
+        compiled step over (measured step cadence x peak silicon FLOP/s).
+        Null-with-rationale where the denominator is unknown (cpu-sim,
+        unlisted device kinds) — published once, like ``trace_overlap``."""
+        if not self._obs_enabled:
+            return
+        from ..obs import export as _obs_export
+
+        if self._peak_flops is None:
+            if not self._mfu_noted_unavailable:
+                self._mfu_noted_unavailable = True
+                _obs_export.note_mfu({
+                    "available": False,
+                    "rationale": (
+                        "no peak-FLOPS table entry for device kind "
+                        f"{jax.devices()[0].device_kind!r} (cpu-sim or "
+                        "unlisted silicon) — MFU needs a silicon peak "
+                        "denominator"
+                    ),
+                })
+            return
+        if not self._mfu_flops or not self._step_dt:
+            return
+        mfu = self._mfu_flops / self._step_dt / self._peak_flops
+        _obs_export.note_mfu({
+            "available": True,
+            "mfu": round(mfu, 4),
+            "flops_per_step": self._mfu_flops,
+            "peak_flops": self._peak_flops,
+            "step_dt": round(self._step_dt, 6),
+        })
+
+    def _maybe_prepare_mfu(self, state: TrainState, batch) -> None:
+        """Stash the current compiled step's cost-model flops for the
+        cadence hook's MFU gauge.  The cost analysis is cached per
+        step-cache key; a MISSING entry is harvested in a background
+        daemon thread from abstract avals captured here — jax's AOT
+        ``lower().compile()`` does not share the jit dispatch cache, so an
+        inline harvest would pay a second full XLA compile on the
+        train-step hot path at every new key (first step, autotune
+        retunes, phase switches).  Skipped entirely when no silicon peak
+        is known — the null-with-rationale record needs no cost model."""
+        if self._peak_flops is None:
+            self._maybe_note_mfu()  # publish the rationale once
+            return
+        key = self._current_step_key
+        cached = self._cost_analysis_cache.get(key)
+        if cached is not None:
+            self._mfu_flops = cached.get("flops")
+            return
+        # pause the gauge until THIS program's flops land: publishing the
+        # previous key's flops against the new program's cadence (for the
+        # whole duration of a background compile) would be wrong, not late
+        self._mfu_flops = None
+        if key in self._cost_analysis_pending:
+            return
+        done = threading.Event()
+        self._cost_analysis_pending[key] = done
+        fn = self._step_cache.get(key)
+
+        def _abstract(x):
+            if not hasattr(x, "shape"):
+                return x
+            sharding = getattr(x, "sharding", None)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+        # host metadata only — live buffers are about to be donated to
+        # the dispatch, so the thread must not hold them
+        a_state, a_batch = jax.tree.map(_abstract, (state, batch))
+
+        def _harvest():
+            try:
+                try:
+                    # deliberately NOT the ledger-mapped span name: this
+                    # compile overlaps step windows on another thread, and
+                    # a mapped span here would wrongly deduct from them
+                    with trace_span("obs/cost_analysis_async"):
+                        compiled = fn.lower(a_state, a_batch).compile()
+                        analysis = compiled.cost_analysis()
+                except Exception as e:  # noqa: BLE001 - backend-dependent
+                    logger.warning(
+                        "step_cost_analysis unavailable on %r backend: %s",
+                        jax.default_backend(), e,
+                    )
+                    from ..telemetry import counters
+
+                    counters.incr("obs/cost_analysis_unavailable")
+                    self._cost_analysis_cache[key] = {}
+                    self._memory_analysis_cache[key] = None
+                    return
+                from ..obs.memory import compiled_memory_analysis
+
+                self._memory_analysis_cache[key] = \
+                    compiled_memory_analysis(compiled)
+                if isinstance(analysis, (list, tuple)):
+                    analysis = analysis[0] if analysis else {}
+                self._cost_analysis_cache[key] = \
+                    dict(analysis) if analysis else {}
+            finally:
+                self._cost_analysis_pending.pop(key, None)
+                done.set()
+
+        threading.Thread(target=_harvest, name="bagua-obs-cost-analysis",
+                         daemon=True).start()
+
+    def _note_static_footprint(self, state: TrainState) -> None:
+        """One-shot static HBM footprint of the live training state +
+        bucket plan (:func:`bagua_tpu.obs.memory.static_footprint`) into
+        the obs summary / exporter gauges.  Host metadata only."""
+        self._footprint_noted = True
+        try:
+            from ..obs import export as _obs_export
+            from ..obs.memory import static_footprint
+
+            _obs_export.note_hbm_footprint(static_footprint(self, state))
+        except Exception as e:  # noqa: BLE001 - accounting must not kill
+            logger.debug("static footprint not computed: %s", e)
+
+    def _maybe_poll_device_memory(self) -> None:
+        """Live ``device.memory_stats()`` poll (real TPU: peak bytes +
+        headroom gauges), throttled to the beacon cadence.  A STABLE
+        unavailable answer (cpu-sim's "no HBM stats") disables polling
+        after publishing the rationale once; transient failures (a runtime
+        hiccup mid-run) keep polling until a consecutive-failure budget —
+        a multi-day run must not lose its capacity gauges to one flake."""
+        if self._mem_poll_dead:
+            return
+        try:
+            from ..obs import export as _obs_export
+            from ..obs.memory import live_memory_stats
+
+            record = live_memory_stats()
+            if record.get("available"):
+                self._mem_poll_failures = 0
+            elif record.get("transient"):
+                self._mem_poll_failures += 1
+                if self._mem_poll_failures >= 5:
+                    self._mem_poll_dead = True
+            else:
+                self._mem_poll_dead = True
+            _obs_export.note_hbm_live(record)
+        except Exception as e:  # noqa: BLE001
+            self._mem_poll_failures += 1
+            if self._mem_poll_failures >= 5:
+                self._mem_poll_dead = True
+            logger.debug("device memory poll failed: %s", e)
 
     def train_step(self, state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         from ..communication import check_abort
@@ -1653,6 +1866,8 @@ class BaguaTrainer:
             gated=self.algorithm.straggler_gates_step,
         )
         self._note_stall_phase(self._last_straggle_sleep)
+        if self._ledger is not None and self._last_straggle_sleep > 0:
+            self._ledger.note_class_window("stall", self._last_straggle_sleep)
         state = self.algorithm.host_pre_step(self, state)
         if self.algorithm.need_reset(self._step_counter - 1):
             self._phase += 1
@@ -1691,11 +1906,21 @@ class BaguaTrainer:
             # queued layout migrations (autotune family switch crossing the
             # optimizer-ownership boundary, flat-resident relayout after a
             # rebucket) convert the live state before the recompiled step
-            # consumes it
-            state = self._pending_state_migration(state)
+            # consumes it; the span feeds the ledger's state_migration class
+            with trace_span("step/state_migration"):
+                state = self._pending_state_migration(state)
             self._pending_state_migration = None
             self._anomaly_skip_window = True
+            if self._ledger_window_class is None:
+                # a migration usually triggers a recompile too, which then
+                # claims the window — the migration span already fed its
+                # own execution wall either way
+                self._ledger_window_class = "state_migration"
         fn = self._get_step_fn()
+        if self._obs_enabled:
+            self._maybe_prepare_mfu(state, batch)
+            if not self._footprint_noted:
+                self._note_static_footprint(state)
         # poison accounting reads the persisted state.step BEFORE dispatch:
         # the buffers are donated to fn, and the compiled fault fires on
         # state.step (which resumes from checkpoints), not the
@@ -1734,6 +1959,7 @@ class BaguaTrainer:
             now = time.monotonic()
             if now - self._last_beacon_write > 2.0:
                 self._last_beacon_write = now
+                self._maybe_poll_device_memory()
                 from ..elastic.membership import write_health_beacon
 
                 write_health_beacon()
@@ -1826,6 +2052,10 @@ class BaguaTrainer:
             self._guard_skips += 1
             self._guard_rewinds_total += 1
             counters.incr("grad_guard/skipped_steps")
+            if self._ledger is not None:
+                # the step's wall was spent, its update discarded: move its
+                # recorded productive seconds to the rewind badput class
+                self._ledger.reclassify_step_rewind(step_no)
             _inject.record_recovery("grad.poison")
             logger.warning(
                 "grad guard: step %d produced non-finite gradients "
@@ -1919,18 +2149,70 @@ class BaguaTrainer:
     def step_cost_analysis(self, state: TrainState, batch) -> Dict[str, Any]:
         """XLA's cost model for the current compiled train step ("flops",
         "bytes accessed", ...) — feeds bench.py's achieved-TFLOP/s and MFU
-        reporting and its physically-impossible-number sanity bound.
-        Returns {} when the backend can't provide one (no reference
-        counterpart; NCCL/CUDA expose no per-step cost model)."""
+        reporting, the per-step ``obs/mfu`` gauge, and the
+        physically-impossible-number sanity bound.  Cached per step-cache
+        key (the lower+compile+query round-trip is paid once per compiled
+        program, not per call); the same pass harvests
+        ``memory_analysis()`` for :meth:`step_memory_analysis`.  Returns {}
+        when the backend can't provide one (no reference counterpart;
+        NCCL/CUDA expose no per-step cost model) — logged at warning with
+        the backend name and counted in ``obs/cost_analysis_unavailable``
+        so the silent-{} path is visible in the fleet view."""
+        from ..telemetry import counters
+
         fn = self._get_step_fn()
+        key = self._current_step_key
+        cached = self._cost_analysis_cache.get(key)
+        if cached is not None:
+            return dict(cached)
+        pending = self._cost_analysis_pending.get(key)
+        if pending is not None:
+            # a background harvest for this key is already compiling the
+            # same program — join it instead of paying a duplicate AOT
+            # compile (minutes on large models)
+            pending.wait(timeout=1800)
+            cached = self._cost_analysis_cache.get(key)
+            if cached is not None:
+                return dict(cached)
         try:
-            analysis = fn.lower(state, batch).compile().cost_analysis()
+            with trace_span("step/cost_analysis"):
+                compiled = fn.lower(state, batch).compile()
+                analysis = compiled.cost_analysis()
         except Exception as e:  # pragma: no cover - backend-dependent
-            logger.info("step_cost_analysis unavailable: %s", e)
+            logger.warning(
+                "step_cost_analysis unavailable on %r backend: %s",
+                jax.default_backend(), e,
+            )
+            counters.incr("obs/cost_analysis_unavailable")
+            self._cost_analysis_cache[key] = {}
+            self._memory_analysis_cache[key] = None
             return {}
+        from ..obs.memory import compiled_memory_analysis
+
+        self._memory_analysis_cache[key] = compiled_memory_analysis(compiled)
         if isinstance(analysis, (list, tuple)):
             analysis = analysis[0] if analysis else {}
-        return dict(analysis) if analysis else {}
+        result = dict(analysis) if analysis else {}
+        if not result:
+            logger.warning(
+                "step_cost_analysis empty on %r backend (cost model "
+                "returned no entries)", jax.default_backend(),
+            )
+            counters.incr("obs/cost_analysis_unavailable")
+        self._cost_analysis_cache[key] = result
+        return dict(result)
+
+    def step_memory_analysis(self, state: TrainState,
+                             batch) -> Optional[Dict[str, int]]:
+        """XLA's compiled-executable memory analysis for the current step
+        (argument/output/temp bytes and a ``peak_bytes`` estimate), cached
+        per step-cache key alongside :meth:`step_cost_analysis`.  None when
+        the backend provides no analysis (cpu-sim) — the static
+        :mod:`bagua_tpu.obs.memory` footprint stays the fit signal there."""
+        key = self._step_key()
+        if key not in self._memory_analysis_cache:
+            self.step_cost_analysis(state, batch)
+        return self._memory_analysis_cache.get(key)
 
     def trace_step(self, state: TrainState, batch):
         """Abstract-eval of the current train-step construction: the jitted

@@ -182,6 +182,11 @@ _declare("BAGUA_OBS_EXPORT_DIR", "str", "",
          "Prometheus textfile).  Empty disables the exporter thread.")
 _declare("BAGUA_OBS_EXPORT_INTERVAL_S", "float", "10",
          "Metrics exporter snapshot period in seconds.")
+_declare("BAGUA_OBS_EXPORT_MAX_BYTES", "int", str(64 * 1024 ** 2),
+         "Size cap for the exporter's append-only `metrics.jsonl`: at the "
+         "cap the file rotates to `metrics.jsonl.1` (replacing the "
+         "previous rotation) so a long run keeps at most two generations "
+         "on disk.  0 disables rotation (unbounded growth).")
 _declare("BAGUA_OBS_FLEET_OUT", "str", "",
          "Coordinator-side fleet snapshot path: the elastic monitor merges "
          "every member's heartbeat health payload (per-rank step, "
@@ -527,6 +532,11 @@ def get_obs_export_dir() -> Optional[str]:
 
 def get_obs_export_interval_s() -> float:
     return env_float("BAGUA_OBS_EXPORT_INTERVAL_S")
+
+
+def get_obs_export_max_bytes() -> int:
+    """metrics.jsonl rotation cap in bytes (0 = unbounded)."""
+    return env_int("BAGUA_OBS_EXPORT_MAX_BYTES")
 
 
 def get_obs_fleet_out() -> Optional[str]:

@@ -25,7 +25,19 @@ Plus the analysis layer on top of those signals:
   device comm seconds + overlap fraction from profiler xplanes
   (null-with-rationale on cpu-sim).
 * :mod:`~bagua_tpu.obs.regress` — bench-trend sentinel against the
-  committed ``BENCH_*.json`` records (``python -m bagua_tpu.obs.regress``).
+  committed ``BENCH_*.json``/``EFFICIENCY.json`` records
+  (``python -m bagua_tpu.obs.regress``).
+
+And the efficiency plane over all of it:
+
+* :mod:`~bagua_tpu.obs.ledger` — goodput/badput wall-clock ledger: every
+  second lands in one class (productive-step, compile, checkpoint,
+  rendezvous, catchup-sync, rewind, stall, idle), exported as gauges,
+  rolled up fleet-wide, rendered by ``python -m bagua_tpu.obs.ledger``;
+  plus the peak-silicon tables behind the per-step ``obs/mfu`` gauge.
+* :mod:`~bagua_tpu.obs.memory` — HBM accounting: static per-plan
+  footprint (exact on cpu-sim), per-step-cache ``memory_analysis()``,
+  live ``device.memory_stats()`` peaks/headroom on real TPU.
 
 Master switch: ``BAGUA_OBS`` (default on; ``off`` restores the exact
 pre-obs host behavior — the compiled step program is identical either way).
@@ -41,6 +53,8 @@ from .export import (  # noqa: F401
     validate_fleet_snapshot,
     write_fleet_snapshot,
 )
+from .export import LEDGER_CLASSES  # noqa: F401
+from .memory import live_memory_stats, plan_flat_bytes, static_footprint  # noqa: F401
 from .recorder import (  # noqa: F401
     dump_flight_record,
     validate_flight_record,
@@ -49,6 +63,8 @@ from .recorder import (  # noqa: F401
 # re-exported here, where it would shadow the ``obs.recorder`` submodule
 from .spans import SpanRecorder, span_ring, trace_span  # noqa: F401
 from .anomaly import StepAnomalyDetector, fleet_straggler_suspects  # noqa: F401,E402
-# NOTE: obs.timeline and obs.regress are NOT imported here — both are
-# `python -m` entry points, and a package-level import would leave a
-# second copy of the module executing under runpy
+# NOTE: obs.timeline, obs.regress, and obs.ledger are NOT imported here —
+# all three are `python -m` entry points, and a package-level import would
+# leave a second copy of the module executing under runpy (the ledger
+# singleton and its validate_efficiency live in obs.ledger; consumers
+# import the module lazily)

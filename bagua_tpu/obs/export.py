@@ -45,11 +45,31 @@ from ..telemetry import counters
 
 logger = logging.getLogger(__name__)
 
+#: goodput-ledger attribution classes (single source of truth for the
+#: `obs/ledger/<cls>_s` gauge names; :mod:`bagua_tpu.obs.ledger` — a
+#: ``python -m`` entry point this module must not import eagerly — reads
+#: them from here)
+LEDGER_CLASSES = (
+    "productive_step", "compile", "state_migration", "checkpoint",
+    "rendezvous", "catchup_sync", "rewind", "stall", "idle_other",
+)
+
+
+def _ledger():
+    # lazy: obs.ledger is a CLI entry point; importing it from package
+    # import time would leave runpy executing a second module copy
+    from .ledger import ledger
+
+    return ledger
+
 __all__ = [
-    "METRIC_REGISTRY", "Metric", "is_registered", "any_registered_matches",
+    "METRIC_REGISTRY", "Metric", "LEDGER_CLASSES",
+    "is_registered", "any_registered_matches",
     "MetricsExporter", "render_prometheus", "local_obs_summary",
     "note_step", "note_step_metrics", "note_anomaly",
     "note_device_attribution", "last_device_attribution",
+    "note_mfu", "last_mfu", "note_hbm_footprint", "last_hbm_footprint",
+    "note_hbm_live", "last_hbm_live",
     "write_fleet_snapshot", "validate_fleet_snapshot", "FLEET_SCHEMA",
 ]
 
@@ -167,6 +187,38 @@ _declare("obs/device_comm_s_per_step", "gauge",
 _declare("obs/device_overlap_fraction", "gauge",
          "Fraction of device comm time hidden under compute in the last "
          "closed profiler window (parse_xplane_overlap).")
+# -- efficiency plane: goodput ledger + MFU + HBM accounting --
+for _cls in LEDGER_CLASSES:
+    _declare(f"obs/ledger/{_cls}_s", "gauge",
+             f"Cumulative wall-clock seconds the goodput ledger attributes "
+             f"to the `{_cls}` class on this rank (docs/observability.md, "
+             "efficiency plane).")
+_declare("obs/ledger/wall_s", "gauge",
+         "Total wall-clock seconds the goodput ledger has covered on this "
+         "rank (the conservation denominator: classes sum to this within "
+         "1%).")
+_declare("obs/goodput_fraction", "gauge",
+         "Fraction of this rank's ledger wall spent in productive steps — "
+         "the fleet's headline efficiency number (everything else is "
+         "badput with a named class).")
+_declare("obs/mfu", "gauge",
+         "Model FLOPS utilization of the current compiled step: cached "
+         "cost-model flops / measured step cadence / peak silicon FLOP/s "
+         "(absent on cpu-sim — the summary carries a rationale instead).")
+_declare("obs/cost_analysis_unavailable", "counter",
+         "step_cost_analysis calls that returned {} because the backend "
+         "offered no cost model (one count per compiled program, not per "
+         "call) — the formerly silent swallow-all, now visible fleet-wide.")
+_declare("obs/hbm_static_footprint_bytes", "gauge",
+         "Static per-device HBM footprint estimate: resident TrainState "
+         "shard bytes + one set of per-bucket gradient flats "
+         "(bagua_tpu.obs.memory.static_footprint; exact on cpu-sim).")
+_declare("obs/hbm_peak_bytes", "gauge",
+         "Live device.memory_stats() peak_bytes_in_use from the last "
+         "beacon-cadence poll (real TPU only; absent on cpu-sim).")
+_declare("obs/hbm_headroom_bytes", "gauge",
+         "bytes_limit minus the live peak from the last memory poll — the "
+         "capacity-planning margin (real TPU only).")
 
 
 def is_registered(name: str) -> bool:
@@ -216,6 +268,9 @@ _LAST_STEP: Optional[int] = None
 _LAST_STEP_METRICS: Dict[str, Any] = {}
 _LAST_ANOMALY: Optional[Dict[str, Any]] = None
 _LAST_DEVICE_ATTRIBUTION: Optional[Dict[str, Any]] = None
+_LAST_MFU: Optional[Dict[str, Any]] = None
+_LAST_HBM_FOOTPRINT: Optional[Dict[str, Any]] = None
+_LAST_HBM_LIVE: Optional[Dict[str, Any]] = None
 
 
 def note_step(step: int, step_dt: Optional[float]) -> None:
@@ -275,6 +330,61 @@ def last_device_attribution() -> Optional[Dict[str, Any]]:
                 if _LAST_DEVICE_ATTRIBUTION is not None else None)
 
 
+def note_mfu(record: Dict[str, Any]) -> None:
+    """Publish the trainer's per-step MFU record: the ``obs/mfu`` gauge
+    when available, the null-with-rationale record either way (the fleet
+    view shows WHY a rank has no MFU column on cpu-sim)."""
+    global _LAST_MFU
+    with _SUMMARY_LOCK:
+        _LAST_MFU = dict(record)
+    if record.get("available") and record.get("mfu") is not None:
+        counters.set_gauge("obs/mfu", float(record["mfu"]))
+
+
+def last_mfu() -> Optional[Dict[str, Any]]:
+    with _SUMMARY_LOCK:
+        return dict(_LAST_MFU) if _LAST_MFU is not None else None
+
+
+def note_hbm_footprint(record: Dict[str, Any]) -> None:
+    """Publish the one-shot static HBM footprint
+    (:func:`bagua_tpu.obs.memory.static_footprint`): summary record + the
+    ``obs/hbm_static_footprint_bytes`` gauge."""
+    global _LAST_HBM_FOOTPRINT
+    with _SUMMARY_LOCK:
+        _LAST_HBM_FOOTPRINT = dict(record)
+    if record.get("total_bytes") is not None:
+        counters.set_gauge("obs/hbm_static_footprint_bytes",
+                           int(record["total_bytes"]))
+
+
+def last_hbm_footprint() -> Optional[Dict[str, Any]]:
+    with _SUMMARY_LOCK:
+        return (dict(_LAST_HBM_FOOTPRINT)
+                if _LAST_HBM_FOOTPRINT is not None else None)
+
+
+def note_hbm_live(record: Dict[str, Any]) -> None:
+    """Publish a live ``device.memory_stats()`` poll
+    (:func:`bagua_tpu.obs.memory.live_memory_stats`): peak/headroom gauges
+    when available, the rationale record either way."""
+    global _LAST_HBM_LIVE
+    with _SUMMARY_LOCK:
+        _LAST_HBM_LIVE = dict(record)
+    if record.get("available"):
+        if record.get("peak_bytes_in_use") is not None:
+            counters.set_gauge("obs/hbm_peak_bytes",
+                               int(record["peak_bytes_in_use"]))
+        if record.get("headroom_bytes") is not None:
+            counters.set_gauge("obs/hbm_headroom_bytes",
+                               int(record["headroom_bytes"]))
+
+
+def last_hbm_live() -> Optional[Dict[str, Any]]:
+    with _SUMMARY_LOCK:
+        return dict(_LAST_HBM_LIVE) if _LAST_HBM_LIVE is not None else None
+
+
 def _percentile(sorted_vals: List[float], q: float) -> float:
     idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
     return sorted_vals[idx]
@@ -291,6 +401,9 @@ def local_obs_summary() -> Optional[dict]:
         anomaly = dict(_LAST_ANOMALY) if _LAST_ANOMALY else None
         attribution = (dict(_LAST_DEVICE_ATTRIBUTION)
                        if _LAST_DEVICE_ATTRIBUTION else None)
+        mfu = dict(_LAST_MFU) if _LAST_MFU else None
+        footprint = dict(_LAST_HBM_FOOTPRINT) if _LAST_HBM_FOOTPRINT else None
+        hbm_live = dict(_LAST_HBM_LIVE) if _LAST_HBM_LIVE else None
     if step is None:
         return None
     summary = {
@@ -317,18 +430,48 @@ def local_obs_summary() -> Optional[dict]:
             summary["device_comm_s_per_step"] = None
             summary["device_attribution_rationale"] = attribution.get(
                 "rationale")
+    # efficiency plane: goodput fraction + badput breakdown (the fleet
+    # rollup names each rank's worst badput class from these), MFU, and the
+    # HBM footprint/headroom — all host-side accounting
+    ledger_report = _ledger().report()
+    if ledger_report is not None:
+        summary["goodput_fraction"] = ledger_report["goodput_fraction"]
+        summary["badput"] = {
+            cls: round(s, 3)
+            for cls, s in ledger_report["classes"].items()
+            if cls != "productive_step" and s > 0
+        }
+        summary["worst_badput_class"] = ledger_report["worst_badput_class"]
+    if mfu:
+        if mfu.get("available"):
+            summary["mfu"] = mfu.get("mfu")
+        else:
+            summary["mfu"] = None
+            summary["mfu_rationale"] = mfu.get("rationale")
+    if footprint:
+        summary["hbm_static_footprint_bytes"] = footprint.get("total_bytes")
+    if hbm_live:
+        if hbm_live.get("available"):
+            summary["hbm_peak_bytes"] = hbm_live.get("peak_bytes_in_use")
+            summary["hbm_headroom_bytes"] = hbm_live.get("headroom_bytes")
+        else:
+            summary["hbm_live_rationale"] = hbm_live.get("rationale")
     return summary
 
 
 def reset_local_summary() -> None:
     """Forget the per-rank summary (test isolation)."""
     global _LAST_STEP, _LAST_ANOMALY, _LAST_DEVICE_ATTRIBUTION
+    global _LAST_MFU, _LAST_HBM_FOOTPRINT, _LAST_HBM_LIVE
     with _SUMMARY_LOCK:
         _LAST_STEP = None
         _STEP_DTS.clear()
         _LAST_STEP_METRICS.clear()
         _LAST_ANOMALY = None
         _LAST_DEVICE_ATTRIBUTION = None
+        _LAST_MFU = None
+        _LAST_HBM_FOOTPRINT = None
+        _LAST_HBM_LIVE = None
 
 
 # ---- Prometheus / JSONL rendering -----------------------------------------
@@ -358,6 +501,22 @@ def render_prometheus(snapshot: Dict[str, Any]) -> str:
             lines.append(f"# TYPE {pname} untyped")
         lines.append(f"{pname} {value}")
     return "\n".join(lines) + "\n"
+
+
+def _maybe_rotate(path: str) -> None:
+    """Size-capped rotation for the append-only ``metrics.jsonl``: once the
+    file reaches ``BAGUA_OBS_EXPORT_MAX_BYTES`` it moves to ``<path>.1``
+    (replacing the previous rotation) and a fresh file starts — a long run
+    can no longer grow the export unboundedly, and readers (the ledger CLI)
+    still see up to two generations of history."""
+    max_bytes = _env.get_obs_export_max_bytes()
+    if max_bytes <= 0:
+        return
+    try:
+        if os.path.getsize(path) >= max_bytes:
+            os.replace(path, path + ".1")
+    except OSError:
+        pass  # no file yet, or a racing rotation — the append creates it
 
 
 def _atomic_write(path: str, text: str) -> None:
@@ -411,6 +570,9 @@ class MetricsExporter:
         # ring drop pressure rides every snapshot: a truncated timeline
         # must read as truncated, not as a quiet run
         counters.set_gauge("obs/spans_dropped", _spans.recorder.dropped)
+        # goodput ledger: refresh the cumulative class/goodput gauges so
+        # every metrics.jsonl line carries a consistent efficiency snapshot
+        _ledger().publish_gauges(counters)
         snap = counters.snapshot()
         record: Dict[str, Any] = {
             "time_unix": time.time(),
@@ -432,7 +594,9 @@ class MetricsExporter:
             dt = getattr(trainer, "measured_step_dt", None)
             if callable(dt):
                 record["measured_step_dt"] = dt()
-        with open(os.path.join(self.directory, "metrics.jsonl"), "a") as f:
+        jsonl = os.path.join(self.directory, "metrics.jsonl")
+        _maybe_rotate(jsonl)
+        with open(jsonl, "a") as f:
             f.write(json.dumps(record) + "\n")
         _atomic_write(os.path.join(self.directory, "metrics.prom"),
                       render_prometheus(snap))
@@ -486,6 +650,33 @@ def maybe_start_global_exporter(trainer: Optional[Any] = None
 FLEET_SCHEMA = "bagua-obs-fleet-v1"
 
 
+def _fleet_efficiency(ranks: Dict[str, dict]) -> dict:
+    """The fleet-level efficiency rollup from merged per-rank obs
+    summaries: mean/min goodput fraction, and per rank the goodput plus its
+    worst (dominant) badput class.  Empty ``ranks`` sub-dict when no member
+    reported a ledger yet (launcher-only fleets, pre-first-step)."""
+    per_rank: Dict[str, dict] = {}
+    fractions: List[float] = []
+    for entry in ranks.values():
+        for rank_id, obs in (entry.get("obs") or {}).items():
+            if not isinstance(obs, dict):
+                continue
+            gf = obs.get("goodput_fraction")
+            if gf is None:
+                continue
+            fractions.append(float(gf))
+            per_rank[str(rank_id)] = {
+                "goodput_fraction": gf,
+                "worst_badput_class": obs.get("worst_badput_class"),
+            }
+    out: dict = {"ranks": per_rank}
+    if fractions:
+        out["goodput_fraction_mean"] = round(
+            sum(fractions) / len(fractions), 6)
+        out["goodput_fraction_min"] = round(min(fractions), 6)
+    return out
+
+
 def write_fleet_snapshot(path: str, epoch: int,
                          members: Dict[int, Optional[dict]]) -> bool:
     """Coordinator-side fleet view: merge every member's latest heartbeat
@@ -512,6 +703,10 @@ def write_fleet_snapshot(path: str, epoch: int,
             "epoch": int(epoch),
             "nnodes": len(members),
             "ranks": ranks,
+            # efficiency rollup: aggregate goodput + each rank's worst
+            # badput class, lifted from the per-rank summaries above — the
+            # fleet-level answer to "where is the fleet's wall-clock going"
+            "efficiency": _fleet_efficiency(ranks),
         }
         _atomic_write(str(path), json.dumps(record, indent=1, sort_keys=True))
         return True
@@ -534,4 +729,12 @@ def validate_fleet_snapshot(record: dict) -> List[str]:
         if not isinstance(entry, dict) or "health" not in entry \
                 or "obs" not in entry:
             problems.append(f"rank {nid}: missing health/obs")
+    eff = record.get("efficiency")
+    if not isinstance(eff, dict) or not isinstance(eff.get("ranks"), dict):
+        problems.append("missing/mistyped efficiency rollup")
+    else:
+        for rid, entry in eff["ranks"].items():
+            if "goodput_fraction" not in entry:
+                problems.append(f"efficiency.ranks[{rid}] missing "
+                                "goodput_fraction")
     return problems

@@ -1,0 +1,176 @@
+"""HBM memory accounting: does the bucket-flat layout fit the device?
+
+Three complementary views, cheapest first:
+
+* **static footprint** (:func:`static_footprint`) — the resident training
+  state's per-device bytes computed from host metadata alone: the live
+  ``TrainState`` leaves (params / optimizer state / algorithm state —
+  per-device shard sizes, so stacked-gossip axes and sharded ZeRO chunks
+  count once, not world-size times) plus the transient per-bucket gradient
+  flats the compiled step materializes (:func:`plan_flat_bytes` over the
+  ``BucketPlan``).  Exact and testable on cpu-sim — the number an operator
+  sizes a config against before ever compiling.
+* **compiled-step analysis** — XLA's ``compile().memory_analysis()``
+  per step-cache entry, harvested alongside the cached cost analysis in
+  ``BaguaTrainer.step_cost_analysis`` when the backend provides one
+  (TPU does; cpu-sim reports null-with-rationale).
+* **live peaks** (:func:`live_memory_stats`) — ``device.memory_stats()``
+  polled off the hot path (the trainer's ~2 s beacon cadence): real
+  ``peak_bytes_in_use`` and the headroom against ``bytes_limit``.  TPU
+  runtimes expose it; cpu-sim returns null-with-rationale, like
+  ``trace_overlap``.
+
+Footprint and headroom ride the per-rank obs summary → health beacon →
+fleet snapshot as gauges, and land in ``EFFICIENCY.json``.  Host-side
+only: nothing here touches the compiled step.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "plan_flat_bytes", "tree_device_bytes", "static_footprint",
+    "compiled_memory_analysis", "live_memory_stats",
+]
+
+
+def plan_flat_bytes(plan) -> int:
+    """Bytes of one full set of flat bucket buffers for a
+    :class:`~bagua_tpu.bucket.BucketPlan` — padding included (the padded
+    numel IS what the compiled step materializes per bucket)."""
+    return int(sum(
+        b.padded_numel * np.dtype(b.dtype).itemsize for b in plan.buckets
+    ))
+
+
+def tree_device_bytes(tree) -> int:
+    """Per-device bytes of a pytree of arrays: each leaf counts its LOCAL
+    shard (``addressable_shards[0]``), so a replicated leaf counts its
+    full size, a stacked/sharded leaf its per-device slice — the HBM a
+    single chip actually holds.  Host metadata only (shapes/dtypes), no
+    readbacks."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if not hasattr(leaf, "nbytes"):
+            continue
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            total += int(shards[0].data.nbytes)
+        else:
+            total += int(leaf.nbytes)
+    return total
+
+
+def static_footprint(trainer, state) -> Dict[str, Any]:
+    """Per-device HBM bytes of a trainer's resident training state plus the
+    step's transient gradient flats — the static fit estimate.
+
+    Components (all per device):
+
+    * ``params_bytes`` / ``opt_state_bytes`` / ``algo_state_bytes`` — the
+      live :class:`TrainState` leaves' shard sizes.  Under the
+      flat-resident layout the params/opt leaves ARE the bucket flats, so
+      this matches the ``BucketPlan`` avals exactly (pinned in
+      ``tests/test_ledger.py``).
+    * ``grad_flats_bytes`` — one set of per-bucket gradient flats
+      (:func:`plan_flat_bytes`): the dominant transient the compiled step
+      materializes between backward and the collective.
+    """
+    plan = getattr(trainer, "_plan", None)
+    record: Dict[str, Any] = {
+        "params_bytes": tree_device_bytes(state.params),
+        "opt_state_bytes": tree_device_bytes(state.opt_state),
+        "algo_state_bytes": tree_device_bytes(
+            getattr(state, "algo_state", None)),
+        "grad_flats_bytes": plan_flat_bytes(plan) if plan is not None else 0,
+        "bucket_count": len(plan.buckets) if plan is not None else 0,
+        "flat_resident": bool(getattr(trainer, "_flat_resident", False)),
+        "per_device": True,
+    }
+    record["total_bytes"] = (
+        record["params_bytes"] + record["opt_state_bytes"]
+        + record["algo_state_bytes"] + record["grad_flats_bytes"]
+    )
+    return record
+
+
+#: attributes a jax ``CompiledExecutable.memory_analysis()`` result may
+#: expose (backend-dependent; missing ones are simply absent)
+_MEMORY_ANALYSIS_FIELDS = (
+    "argument_size_in_bytes", "output_size_in_bytes",
+    "temp_size_in_bytes", "alias_size_in_bytes",
+    "generated_code_size_in_bytes", "host_argument_size_in_bytes",
+    "host_output_size_in_bytes", "host_temp_size_in_bytes",
+    "host_generated_code_size_in_bytes", "serialized_size_in_bytes",
+)
+
+
+def compiled_memory_analysis(compiled) -> Optional[Dict[str, int]]:
+    """Extract the plain-int fields from a compiled executable's
+    ``memory_analysis()`` (None when the backend offers none — cpu-sim's
+    null-with-rationale case).  Adds ``peak_bytes`` = arguments + outputs +
+    temps when all three are present: the executable's own HBM high-water
+    estimate."""
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception as e:  # noqa: BLE001 - backend-dependent surface
+        logger.debug("memory_analysis unavailable: %s", e)
+        return None
+    if analysis is None:
+        return None
+    out: Dict[str, int] = {}
+    for field in _MEMORY_ANALYSIS_FIELDS:
+        value = getattr(analysis, field, None)
+        if isinstance(value, (int, np.integer)):
+            out[field] = int(value)
+    if not out:
+        return None
+    if all(k in out for k in ("argument_size_in_bytes",
+                              "output_size_in_bytes",
+                              "temp_size_in_bytes")):
+        out["peak_bytes"] = (out["argument_size_in_bytes"]
+                             + out["output_size_in_bytes"]
+                             + out["temp_size_in_bytes"])
+    return out
+
+
+def live_memory_stats(device=None) -> Dict[str, Any]:
+    """One poll of ``device.memory_stats()`` (the first local device by
+    default): ``{"available": True, bytes_in_use, peak_bytes_in_use,
+    bytes_limit, headroom_bytes}`` on runtimes that expose it (TPU), else
+    ``{"available": False, "rationale": ...}`` — null-with-rationale, so a
+    fleet view can show *why* a rank has no live-memory column."""
+    import jax
+
+    if device is None:
+        device = jax.local_devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception as e:  # noqa: BLE001 - backend-dependent surface
+        # transient: a runtime hiccup, not "this backend never has HBM
+        # stats" — callers should keep polling (with a budget)
+        return {"available": False, "transient": True,
+                "rationale": f"memory_stats raised {type(e).__name__}: {e}"}
+    if not stats:
+        return {"available": False,
+                "rationale": f"device {device.device_kind!r} reports no "
+                             "memory_stats (cpu-sim has no HBM)"}
+    record: Dict[str, Any] = {"available": True,
+                              "device_kind": device.device_kind}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size"):
+        if key in stats:
+            record[key] = int(stats[key])
+    limit = record.get("bytes_limit")
+    peak = record.get("peak_bytes_in_use", record.get("bytes_in_use"))
+    if limit is not None and peak is not None:
+        record["headroom_bytes"] = int(limit - peak)
+    return record

@@ -72,6 +72,15 @@ def _armed_fault_summaries() -> List[dict]:
     ]
 
 
+def _ledger_report() -> Optional[dict]:
+    try:
+        from .ledger import ledger
+
+        return ledger.report()
+    except Exception:  # noqa: BLE001 - a dying process must still die
+        return None
+
+
 def _fired_fault_counts(snap: Dict[str, Any]) -> Dict[str, int]:
     out: Dict[str, int] = {}
     for name, value in snap.items():
@@ -132,6 +141,10 @@ def dump_flight_record(trigger: str, reason: str = "",
             "obs_summary": _export.local_obs_summary(),
             "armed_faults": _armed_fault_summaries(),
             "fired_faults": _fired_fault_counts(snap),
+            # goodput ledger at the moment the defense tripped: "the run
+            # died having spent N s in class X" is post-mortem headline
+            # material (None before any step window was noted)
+            "ledger": _ledger_report(),
         }
         if extra:
             record["extra"] = extra

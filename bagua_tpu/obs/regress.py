@@ -65,21 +65,27 @@ def _half_spread(record: dict) -> float:
         return 0.0
 
 
-def _is_higher_better(*records: dict) -> bool:
-    """Whether a record pair is a known HIGHER-is-better quantity: a
-    throughput record (unit carries a rate, ``.../s...``) or an _ab.py
-    speedup record (``per_trial_ratios``/``faster_path``).  Anything else
-    — compile times, HLO op-count ratios, byte counts — is skipped rather
-    than compared with an assumed direction: a lower-is-better metric
-    run through a higher-is-better comparison INVERTS the verdict, which
-    is worse than no verdict."""
+def _direction(*records: dict) -> Optional[bool]:
+    """The comparison direction for a record pair: True (higher is
+    better), False (lower is better — e.g. memory footprints), or None
+    (unknown — the pair is skipped rather than compared with an assumed
+    direction: a lower-is-better metric run through a higher-is-better
+    comparison INVERTS the verdict, which is worse than no verdict).
+
+    An explicit ``higher_better`` field (the EFFICIENCY.json trend records
+    carry one) wins; otherwise the heuristic recognizes throughput records
+    (unit carries a rate, ``.../s...``) and _ab.py speedup records
+    (``per_trial_ratios``/``faster_path``) as higher-is-better."""
+    for rec in records:
+        if isinstance(rec.get("higher_better"), bool):
+            return rec["higher_better"]
     for rec in records:
         unit = rec.get("unit") or ""
         if "/s" in unit:
             return True
         if "per_trial_ratios" in rec or "faster_path" in rec:
             return True
-    return False
+    return None
 
 
 def compare_records(fresh: Sequence[dict], committed: Sequence[dict],
@@ -109,14 +115,17 @@ def compare_records(fresh: Sequence[dict], committed: Sequence[dict],
         if not isinstance(fv, (int, float)) \
                 or not isinstance(cv, (int, float)) or cv <= 0 or fv <= 0:
             continue
-        if not _is_higher_better(rec, base):
+        higher = _direction(rec, base)
+        if higher is None:
             continue
         ratio = fv / cv
+        # score normalizes direction: > 1 is always "got better"
+        score = ratio if higher else cv / fv
         tol = max(float(tolerance), _half_spread(base), _half_spread(rec))
         noisy = bool(base.get("noise_bound") or rec.get("noise_bound"))
-        if ratio < 1.0 - tol:
+        if score < 1.0 - tol:
             verdict = "noise_bound" if noisy else "regressed"
-        elif ratio > 1.0 + tol:
+        elif score > 1.0 + tol:
             verdict = "improved"
         else:
             verdict = "ok"
@@ -126,6 +135,7 @@ def compare_records(fresh: Sequence[dict], committed: Sequence[dict],
             "committed_value": cv,
             "unit": rec.get("unit") or base.get("unit"),
             "ratio": round(ratio, 3),
+            "higher_better": higher,
             "tolerance": round(tol, 3),
             "noise_bound": noisy,
             "verdict": verdict,
@@ -201,7 +211,18 @@ def _run_probe_inprocess(trials: int) -> List[dict]:
         "flat_speedup_gradient_allreduce_accum1", ratios, "flat/leaf",
         faster_path=faster, platform=on["platform"],
     )
-    return [off, on, speedup]
+    records = [off, on, speedup]
+    # efficiency trend records (EFFICIENCY.json consumption): the quick
+    # probe re-measures the headline config's goodput + static footprint.
+    # The footprint comparison is deterministic (memory bloat WILL flag);
+    # the goodput one is marked noise_bound by the quick measure itself.
+    try:
+        from benchmarks.efficiency_bench import efficiency_trend_records
+
+        records += efficiency_trend_records(quick=True)
+    except Exception as e:  # noqa: BLE001 - advisory sentinel stays alive
+        logger.warning("efficiency probe skipped: %s", e)
+    return records
 
 
 def build_trend(comparisons: List[dict], mode: str,
@@ -294,7 +315,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(json.dumps(records))
         return 0
 
-    against = args.against or [os.path.join(_REPO, "BENCH_FLAT.json")]
+    against = args.against
+    if not against:
+        against = [os.path.join(_REPO, "BENCH_FLAT.json")]
+        # the efficiency artifact joins the default comparison set when
+        # committed: its trend_records carry explicit directions
+        efficiency = os.path.join(_REPO, "EFFICIENCY.json")
+        if os.path.exists(efficiency):
+            against.append(efficiency)
     committed: List[dict] = []
     for path in against:
         try:
@@ -304,12 +332,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"cannot read committed records {path}: {e}",
                   file=sys.stderr)
             return 2
-        committed.extend(data if isinstance(data, list) else [data])
+        if isinstance(data, dict) and "trend_records" in data:
+            # an EFFICIENCY.json-shaped artifact: compare its embedded
+            # trend records (schema-gated in test_bench_sanity)
+            committed.extend(data["trend_records"])
+        else:
+            committed.extend(data if isinstance(data, list) else [data])
 
     trials: Optional[int] = None
     if args.fresh:
         with open(args.fresh) as f:
             fresh = json.load(f)
+        if isinstance(fresh, dict) and "trend_records" in fresh:
+            fresh = fresh["trend_records"]
         mode = "files"
     else:
         trials = max(1, args.trials)

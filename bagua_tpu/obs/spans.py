@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Optional
 from .. import env as _env
 
 __all__ = ["trace_span", "recorder", "span_ring", "SpanRecorder", "enabled",
-           "set_enabled", "set_current_step"]
+           "set_enabled", "set_current_step", "set_ledger_sink"]
 
 #: resolved master switch; None = not yet read from BAGUA_OBS
 _ENABLED: Optional[bool] = None
@@ -87,6 +87,20 @@ _CURRENT_STEP: Optional[int] = None
 def set_current_step(step: Optional[int]) -> None:
     global _CURRENT_STEP
     _CURRENT_STEP = step
+
+
+#: goodput-ledger sink (``bagua_tpu.obs.ledger.install()`` sets it): spans
+#: whose names map to a ledger class feed their wall seconds on close.
+#: None (the default) keeps the enter/exit pair at its pre-ledger cost.
+_LEDGER_SINK = None
+
+
+def set_ledger_sink(sink) -> None:
+    """Install (or clear, with None) the goodput-ledger span sink — an
+    object with ``span_enter(name) -> cls|None`` and
+    ``span_exit(cls, dur_s)``."""
+    global _LEDGER_SINK
+    _LEDGER_SINK = sink
 
 
 class SpanRecorder:
@@ -187,7 +201,7 @@ class _Span:
     enter/exit pair sits on the train-step hot path (measured in
     ``tests/test_obs.py`` against the <2%-of-step-time budget)."""
 
-    __slots__ = ("name", "attrs", "t0", "step")
+    __slots__ = ("name", "attrs", "t0", "step", "ledger_cls")
 
     def __init__(self, name: str, attrs: Dict[str, Any]):
         self.name = name
@@ -196,6 +210,11 @@ class _Span:
     def __enter__(self):
         self.step = self.attrs.pop("step", _CURRENT_STEP)
         depth = recorder._enter()
+        # ledger ownership resolves at open (outermost mapped span wins);
+        # one global read when no sink is installed
+        self.ledger_cls = (
+            _LEDGER_SINK.span_enter(self.name) if _LEDGER_SINK else None
+        )
         self.t0 = time.monotonic()
         recorder.open_span(id(self), {
             "name": self.name,
@@ -211,6 +230,8 @@ class _Span:
         t1 = time.monotonic()
         depth = getattr(recorder._local, "depth", 1) - 1
         recorder._exit()
+        if self.ledger_cls is not None and _LEDGER_SINK is not None:
+            _LEDGER_SINK.span_exit(self.ledger_cls, t1 - self.t0)
         span = {
             "name": self.name,
             "t0": self.t0,

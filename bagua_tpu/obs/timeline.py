@@ -108,6 +108,7 @@ def dump_span_ring(path: str, rank: Optional[int] = None) -> str:
     profiling window.  Returns the path written."""
     from .. import env as _env
     from . import export as _export
+    from . import ledger as _ledger
     from . import spans as _spans
 
     record = {
@@ -116,6 +117,10 @@ def dump_span_ring(path: str, rank: Optional[int] = None) -> str:
         "spans": _spans.recorder.snapshot(),
         "active_spans": _spans.recorder.active_snapshot(),
         "spans_dropped": _spans.recorder.dropped,
+        # goodput ledger: the cumulative class history becomes a Perfetto
+        # counter track alongside this rank's span track
+        "ledger": _ledger.ledger.report(),
+        "ledger_samples": _ledger.ledger.samples(),
     }
     _export._atomic_write(path, json.dumps(record, indent=1))
     return path
@@ -206,8 +211,15 @@ def assemble_timeline(rank_records: Sequence[dict],
     active_by_rank: Dict[int, List[dict]] = {}
     dropped_by_rank: Dict[int, int] = {}
     sources_by_rank: Dict[int, List[str]] = {}
+    ledger_by_rank: Dict[int, Dict[float, dict]] = {}
     for rec in rank_records:
         rank = int(rec["rank"])
+        for sample in rec.get("ledger_samples") or []:
+            if isinstance(sample, dict) and "t" in sample \
+                    and isinstance(sample.get("classes"), dict):
+                # keyed by t: multiple dumps of one rank dedupe naturally
+                ledger_by_rank.setdefault(rank, {})[sample["t"]] = \
+                    sample["classes"]
         seen = {_span_identity(s) for s in spans_by_rank.get(rank, [])}
         for span in rec.get("spans") or []:
             if not isinstance(span, dict) or "t0" not in span:
@@ -297,6 +309,17 @@ def assemble_timeline(rank_records: Sequence[dict],
                 "cat": span["name"].split("/", 1)[0],
                 "args": args,
             })
+        # goodput-ledger counter track: cumulative per-class seconds
+        # sampled at each step-window close — Perfetto stacks the series,
+        # so badput growth is visible at a glance next to the span track
+        for t in sorted(ledger_by_rank.get(rank, {})):
+            events.append({
+                "ph": "C", "name": "ledger_s", "pid": rank,
+                "ts": _us(rank, t),
+                "cat": "ledger",
+                "args": {cls: val for cls, val
+                         in sorted(ledger_by_rank[rank][t].items())},
+            })
     events.sort(key=lambda e: (e.get("ts", -1), e["pid"]))
     return {
         "traceEvents": events,
@@ -316,6 +339,7 @@ def assemble_timeline(rank_records: Sequence[dict],
                     # the whole run — the satellite that makes truncation
                     # visible instead of silent
                     "spans_dropped": dropped_by_rank.get(rank, 0),
+                    "ledger_samples": len(ledger_by_rank.get(rank, {})),
                     "sources": sorted(set(sources_by_rank.get(rank, []))),
                 }
                 for rank in sorted(spans_by_rank)
@@ -354,6 +378,11 @@ def validate_timeline(record: dict) -> List[str]:
         elif ev["ph"] == "B":
             if not isinstance(ev.get("ts"), (int, float)) or "tid" not in ev:
                 problems.append(f"event[{i}]: B needs ts, tid")
+        elif ev["ph"] == "C":
+            if not isinstance(ev.get("ts"), (int, float)) \
+                    or not isinstance(ev.get("args"), dict) \
+                    or not ev["args"]:
+                problems.append(f"event[{i}]: C needs ts and series args")
         elif ev["ph"] != "M":
             problems.append(f"event[{i}]: unexpected phase {ev['ph']!r}")
         if len(problems) > 20:

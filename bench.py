@@ -46,24 +46,10 @@ IMAGE_SIZE = 224
 WARMUP_STEPS = 5
 TIMED_STEPS = 40
 
-# Peak per-chip specs for MFU / roofline reporting.  Keys are
-# ``jax.devices()[0].device_kind`` strings.
-PEAK_TFLOPS_BF16 = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,       # v5e
-    "TPU v5": 459.0,
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,       # Trillium
-    "TPU v6e": 918.0,
-}
-PEAK_HBM_GBPS = {
-    "TPU v4": 1228.0,
-    "TPU v5 lite": 819.0,
-    "TPU v5": 2765.0,
-    "TPU v5p": 2765.0,
-    "TPU v6 lite": 1640.0,
-    "TPU v6e": 1640.0,
-}
+# Peak per-chip specs for MFU / roofline reporting, keyed by
+# ``jax.devices()[0].device_kind``.  One table shared with the trainer's
+# per-step obs/mfu gauge (bagua_tpu.obs.ledger owns it).
+from bagua_tpu.obs.ledger import PEAK_HBM_GBPS, PEAK_TFLOPS_BF16  # noqa: E402
 # Nothing on earth sustains this per chip; generic bound when the device
 # kind is unknown (keeps the sanity check alive on new hardware)
 ABSURD_TFLOPS = 2000.0

@@ -120,6 +120,16 @@ def _counter_deltas(before):
             if after.get(k, 0) != before.get(k, 0)}
 
 
+#: drill name -> the goodput-ledger badput class its defense path must
+#: FEED (ISSUE 10): a rewound step's wall lands in `rewind`, a forced
+#: catch-up in `catchup_sync`, the fallback-restore walk in `checkpoint` —
+#: asserted as a class-delta across the drill, so an efficiency regression
+#: in a recovery path can't hide behind a passing recovery verdict.  One
+#: mapping, shared with the test_bench_sanity artifact gate.
+from bagua_tpu.obs.ledger import (  # noqa: E402
+    DRILL_BADPUT_EXPECTATIONS as LEDGER_EXPECTATIONS,
+)
+
 #: drill name -> the fault point (or non-fault trigger) whose
 #: flight-recorder dump the drill must leave behind
 FLIGHT_EXPECTATIONS = {
@@ -134,6 +144,24 @@ FLIGHT_EXPECTATIONS = {
     "async_partition_staleness_catchup": {"fault_point": "async.partition"},
     "health_fence_flight_record": {"trigger": "health_fence"},
 }
+
+
+def _ledger_class_check(cls, before, after):
+    """The class-delta verdict the drill matrix records: the drill's
+    defense path must have added wall seconds to its badput class (and,
+    for rewind, one reclassified window per grad-guard skip)."""
+    before_classes = (before or {}).get("classes") or {}
+    after_classes = (after or {}).get("classes") or {}
+    delta = round(after_classes.get(cls, 0.0)
+                  - before_classes.get(cls, 0.0), 6)
+    verdict = {"badput_class": cls, "delta_s": delta,
+               "surfaced": delta > 0}
+    if cls == "rewind":
+        verdict["rewind_windows_delta"] = (
+            (after or {}).get("rewind_windows", 0)
+            - (before or {}).get("rewind_windows", 0)
+        )
+    return verdict
 
 
 def _flight_record_check(expect):
@@ -847,9 +875,16 @@ def main(argv=None):
             ap.error(f"unknown drill(s) {unknown}; choose from "
                      f"{sorted(drills)}")
         drills = {n: drills[n] for n in args.only}
+    # the goodput ledger observes every drill's defense path (the span
+    # sink is normally installed by the first trainer; install explicitly
+    # so span-only drills — the checkpoint walk — feed it too)
+    from bagua_tpu.obs import ledger as obs_ledger
+
+    obs_ledger.install()
     results = {}
     for name, fn in drills.items():
         print(f"=== {name} ===", flush=True)
+        ledger_before = obs_ledger.ledger.report()
         try:
             results[name] = fn()
         except Exception as e:  # noqa: BLE001 - drill verdicts, not crashes
@@ -862,6 +897,11 @@ def main(argv=None):
             # the failure mode must have left its post-mortem artifact: a
             # schema-valid flight dump naming the firing fault point
             results[name]["flight_record"] = _flight_record_check(expect)
+        ledger_cls = LEDGER_EXPECTATIONS.get(name)
+        if ledger_cls is not None:
+            # the drill's badput must have SURFACED in its ledger class
+            results[name]["ledger"] = _ledger_class_check(
+                ledger_cls, ledger_before, obs_ledger.ledger.report())
         print(f"    {results[name]}", flush=True)
         inject.clear_plan()
         bagua_tpu.reset_abort()
@@ -869,6 +909,7 @@ def main(argv=None):
     passed = all(
         r["detected"] and r["recovered"]
         and r.get("flight_record", {}).get("schema_valid", True)
+        and r.get("ledger", {}).get("surfaced", True)
         for r in results.values()
     )
     record = {

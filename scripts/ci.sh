@@ -27,9 +27,11 @@ JAX_PLATFORMS=cpu python scripts/gen_env_docs.py --check
 echo "=== obs smoke trace (flight recorder on one live drill) ==="
 # One drill from the chaos matrix with the observability plane on: the
 # drill itself asserts its flight-recorder dump exists, schema-validates,
-# and names the firing fault point (exit code carries the verdict).  The
-# full-matrix CHAOS_DRILL.json is schema-gated in test_bench_sanity.py.
+# names the firing fault point, and surfaces its badput class in the
+# goodput ledger (exit code carries the verdict).  The full-matrix
+# CHAOS_DRILL.json is schema-gated in test_bench_sanity.py.
 OBS_TMP="$(mktemp -d)"
+BAGUA_OBS_EXPORT_DIR="$OBS_TMP/export" BAGUA_OBS_EXPORT_INTERVAL_S=1 \
 python scripts/chaos_drill.py --only nan_grad_skip_loss_continuity \
   --dump-dir "$OBS_TMP/dumps"
 
@@ -38,6 +40,13 @@ echo "=== fleet timeline from the drill's flight dumps ==="
 # clock-aligned Perfetto trace — the analysis layer's own end-to-end gate.
 python -m bagua_tpu.obs.timeline "$OBS_TMP/dumps" \
   --out "$OBS_TMP/timeline.json" --check
+
+echo "=== goodput ledger over the smoke trace's metrics export ==="
+# The drill's exporter wrote metrics.jsonl with the ledger gauges aboard;
+# the CLI renders the per-run report and gates conservation (every class
+# second accounted, classes sum to wall within 1%).
+python -m bagua_tpu.obs.ledger "$OBS_TMP/export" \
+  --flight "$OBS_TMP/dumps" --check
 rm -rf "$OBS_TMP"
 
 echo "=== bench trend sentinel (advisory) ==="

@@ -47,6 +47,8 @@ MODULES = [
     "bagua_tpu.obs.anomaly",
     "bagua_tpu.obs.attribution",
     "bagua_tpu.obs.regress",
+    "bagua_tpu.obs.ledger",
+    "bagua_tpu.obs.memory",
     "bagua_tpu.profiling",
     "bagua_tpu.parallel.mesh",
     "bagua_tpu.parallel.tensor_parallel",

@@ -199,6 +199,22 @@ def test_chaos_drill_artifact_schema():
     assert timeline["aligned"] is True, timeline
     assert timeline["ranks"] == ["0", "1"], timeline
     assert timeline["anchor_spans_rank1"] >= 2, timeline
+    # the efficiency plane (ISSUE 10): the rewind, catch-up, and
+    # checkpoint-fallback drills each surfaced their badput class in the
+    # goodput ledger — a recovery path that stopped feeding its class
+    # would pass its recovery verdict yet fail here.  The mapping is the
+    # producer's own (one source; a new ledger-checked drill can't
+    # silently drop out of this gate).
+    from bagua_tpu.obs.ledger import DRILL_BADPUT_EXPECTATIONS
+
+    assert len(DRILL_BADPUT_EXPECTATIONS) >= 3
+    for name, cls in DRILL_BADPUT_EXPECTATIONS.items():
+        led = record["faults"][name]["ledger"]
+        assert led["badput_class"] == cls, (name, led)
+        assert led["surfaced"] is True, (name, led)
+        assert led["delta_s"] > 0, (name, led)
+    assert record["faults"]["nan_grad_skip_loss_continuity"]["ledger"][
+        "rewind_windows_delta"] == 1
 
 
 def test_bench_trend_artifact_schema():
@@ -231,6 +247,57 @@ def test_bench_trend_artifact_schema():
         c["metric"] for c in record["comparisons"]
         if c["verdict"] == "regressed"
     }
+
+
+def test_efficiency_artifact_schema():
+    """EFFICIENCY.json (driver-visible artifact of
+    benchmarks/efficiency_bench.py): the committed efficiency record must
+    schema-validate, conserve its ledger (classes sum to wall within 1%),
+    prove the instrumented badput classes were FED (compile, checkpoint,
+    rewind), carry an exact internally-consistent HBM footprint, keep MFU
+    null-with-rationale on cpu-sim, and embed direction-tagged trend
+    records for the regress sentinel (regenerate with
+    `JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+    python benchmarks/efficiency_bench.py`)."""
+    import json
+    import os
+
+    from bagua_tpu.obs.ledger import validate_efficiency
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "EFFICIENCY.json")
+    assert os.path.exists(path), "run benchmarks/efficiency_bench.py first"
+    record = json.load(open(path))
+    assert validate_efficiency(record) == [], validate_efficiency(record)
+    assert record["platform"] == "cpu-sim" and record["n_devices"] == 8
+    led = record["ledger"]
+    classes = led["classes"]
+    # conservation: every wall second accounted, within the 1% gate
+    assert sum(classes.values()) <= led["wall_s"] * 1.01
+    assert abs(sum(classes.values()) - led["wall_s"]) <= led["wall_s"] * 0.01
+    # the instrumented run deliberately exercised these classes
+    for cls in ("productive_step", "compile", "checkpoint", "rewind"):
+        assert classes[cls] > 0, (cls, classes)
+    assert led["rewind_windows"] == 1  # one seeded grad.poison skip
+    assert 0.0 < led["goodput_fraction"] < 1.0
+    # footprint: exact avals, internally consistent (the flat-vs-plan
+    # byte-for-byte pin lives in tests/test_ledger.py)
+    fp = record["footprint"]
+    assert fp["total_bytes"] == (fp["params_bytes"] + fp["opt_state_bytes"]
+                                 + fp["algo_state_bytes"]
+                                 + fp["grad_flats_bytes"])
+    assert fp["params_bytes"] > 0 and fp["grad_flats_bytes"] > 0
+    # MFU on cpu-sim: null-with-rationale, never a fabricated number
+    assert record["mfu"]["available"] is False
+    assert record["mfu"]["rationale"]
+    # trend records carry explicit directions for the sentinel
+    by_metric = {r["metric"]: r for r in record["trend_records"]}
+    assert by_metric["efficiency_goodput_fraction"]["higher_better"] is True
+    assert by_metric["efficiency_goodput_fraction"]["noise_bound"] is True
+    footprint_rec = by_metric["efficiency_hbm_static_footprint_bytes"]
+    assert footprint_rec["higher_better"] is False
+    assert footprint_rec["noise_bound"] is False
+    assert footprint_rec["value"] == fp["total_bytes"]
 
 
 def test_straggler_bench_artifact_schema():
