@@ -58,18 +58,24 @@ class ByteGradAlgorithm(Algorithm):
         # is still being produced by the backward
         use_hier = (
             self.hierarchical
-            and ctx.internode is not None
-            and ctx.intranode is not None
+            and ctx.two_tier()
             and ctx.internode.nranks() > 1
-            and ctx.intranode.nranks() > 1
         )
         if use_hier:
-            flat = ctx.intranode.allreduce(
-                flat, ReduceOp.AVG if self.average else ReduceOp.SUM
+            # two-level form, codec on the DCN stage ONLY — compress where
+            # bytes are expensive: full-precision slice-local
+            # reduce-scatter (ICI is cheap), the compressed scatter-gather
+            # runs on the 1/intra shard across slices (DCN carries
+            # compressed bytes of the SHARD, not of the whole bucket), then
+            # a full-precision slice-local allgather re-replicates.  The
+            # shard divides the inter world because buckets are padded to
+            # the full world size (tensors_to_buckets above).
+            op = ReduceOp.AVG if self.average else ReduceOp.SUM
+            chunk = ctx.tier_reduce_scatter(flat, op)
+            chunk = compressed_scatter_gather_allreduce(
+                ctx.internode, chunk, average=self.average
             )
-            return compressed_scatter_gather_allreduce(
-                ctx.internode, flat, average=self.average
-            )
+            return ctx.tier_allgather(chunk)
         if ctx.comm.nranks() > 1:
             return compressed_scatter_gather_allreduce(
                 ctx.comm, flat, average=self.average

@@ -217,8 +217,13 @@ class ZeroOptimizerAlgorithm(Algorithm):
             # chunked ring when the overlap scheduler set a chunk size,
             # fused psum_scatter otherwise (identical chunk layout)
             return ctx.bucket_reduce_scatter(flat, ReduceOp.AVG)
-        chunk = ctx.intranode.reduce_scatter(flat, ReduceOp.AVG)
-        return ctx.internode.allreduce(chunk, ReduceOp.AVG)
+        # staged: the per-tier helpers ring-chunk each stage against its
+        # own link-class target (ICI for the intra scatter, DCN for the
+        # inter allreduce) when the overlap scheduler set them; fused
+        # psum_scatter/psum otherwise — jaxpr-identical to the pre-tier
+        # construction
+        chunk = ctx.tier_reduce_scatter(flat, ReduceOp.AVG)
+        return ctx.tier_allreduce(chunk, ReduceOp.AVG)
 
     # ---- overlap scheduler stages ---------------------------------------
 
@@ -375,11 +380,12 @@ class ZeroOptimizerAlgorithm(Algorithm):
             # re-replicate (rank chunks in rank order over the shard axis;
             # staged: every inter row gathers the identical chunks, so the
             # result stays replicated across inter with no inter traffic).
-            # Non-staged: the chunk-aware gather, so the ring pair stays
-            # layout-symmetric when overlap chunking is on.
+            # Both gathers are chunk-aware, so the ring pair stays
+            # layout-symmetric when overlap chunking is on (the staged one
+            # against the ICI tier's target).
             new_flats.append(
                 ctx.bucket_allgather(pchunk) if shard is ctx.comm
-                else shard.allgather(pchunk, tiled=True)
+                else ctx.tier_allgather(pchunk)
             )
             new_states.append(st)
         new_params = {"flats": tuple(new_flats), "local": params["local"]}

@@ -65,7 +65,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="run only the jaxpr consistency sweep")
     ap.add_argument("--families", default=None,
                     help="comma-separated algorithm families for the jaxpr "
-                         "sweep (default: gradient_allreduce,zero,bytegrad)")
+                         "sweep; a ':hier' suffix traces the hierarchical "
+                         "two-level construction on a 2-slice mesh "
+                         "(default: gradient_allreduce,zero,bytegrad plus "
+                         "their :hier variants)")
     ap.add_argument("--accum-steps", default=None,
                     help="comma-separated accum_steps for the sweep "
                          "(default: 1,4)")

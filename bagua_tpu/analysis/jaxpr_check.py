@@ -46,8 +46,15 @@ COLLECTIVE_PRIMS = {
     "pbroadcast": "pbroadcast",
 }
 
-#: families the CLI sweep proves overlap-vs-serialized equivalence for
-DEFAULT_FAMILIES = ("gradient_allreduce", "zero", "bytegrad")
+#: families the CLI sweep proves overlap-vs-serialized equivalence for.
+#: A ``:hier`` suffix traces the family's HIERARCHICAL two-level
+#: construction on a 2-slice x 4-chip ('inter','intra') mesh (ISSUE 11) —
+#: intra reduce-scatter, inter allreduce on the 1/intra shard, intra
+#: allgather — so the consistency checks (axis binding, cond agreement,
+#: overlap-vs-serialized multiset equality) cover the tiered collectives
+#: too.
+DEFAULT_FAMILIES = ("gradient_allreduce", "zero", "bytegrad",
+                    "gradient_allreduce:hier", "zero:hier", "bytegrad:hier")
 DEFAULT_ACCUM_STEPS = (1, 4)
 
 
@@ -228,8 +235,18 @@ def _bucket_accounting(trainer, collectives: Sequence[Collective]) -> List[dict]
 
     def numels_of(bucket) -> Tuple[int, ...]:
         padded = bucket.padded_numel
-        chunk = padded // world if padded % world == 0 else -1
-        return (padded, chunk)
+        sizes = {padded}
+        if padded % world == 0:
+            sizes.add(padded // world)
+        intra = getattr(trainer, "_intra", None)
+        if intra is not None and getattr(trainer, "_inter", None) is not None:
+            # hierarchical two-level payloads: the intra-padded flat (the
+            # decomposition zero-pads buckets the intra world does not
+            # divide) and its 1/intra shard (the DCN-stage operand)
+            ni = intra.nranks()
+            p2 = -(-padded // ni) * ni
+            sizes.update({p2, p2 // ni})
+        return tuple(sorted(sizes))
 
     buckets = list(trainer._plan.buckets)
     # matches per size-group, then an even share per member bucket
@@ -346,30 +363,48 @@ def make_family_tracer(
     family: str, accum_steps: int, bucket_bytes: int = 2048
 ) -> Callable[[str], Tuple[Any, Any]]:
     """``trace_fn(overlap_mode) -> (trainer, ClosedJaxpr)`` for one
-    algorithm family's real step builder on the ambient (cpu-sim) mesh."""
+    algorithm family's real step builder on the ambient (cpu-sim) mesh —
+    or, for a ``family:hier`` spec, the hierarchical two-level construction
+    on a 2-slice x 4-chip ``('inter','intra')`` mesh."""
     import optax
 
     from ..core.backend import BaguaTrainer
+
+    base_family, _, variant = family.partition(":")
+    hierarchical = variant == "hier"
+    if variant and not hierarchical:
+        raise ValueError(f"unknown family variant {family!r}")
 
     def build(overlap: str):
         from .. import algorithms
 
         params, batch, loss_fn = _mlp_fixture()
-        if family == "gradient_allreduce":
-            algo = algorithms.GradientAllReduceAlgorithm()
+        if base_family == "gradient_allreduce":
+            algo = algorithms.GradientAllReduceAlgorithm(
+                hierarchical=hierarchical)
             optimizer = optax.sgd(1e-2)
-        elif family == "bytegrad":
-            algo = algorithms.ByteGradAlgorithm(hierarchical=False)
+        elif base_family == "bytegrad":
+            algo = algorithms.ByteGradAlgorithm(hierarchical=hierarchical)
             optimizer = optax.sgd(1e-2)
-        elif family == "zero":
-            algo = algorithms.ZeroOptimizerAlgorithm(optax.adam(1e-3))
+        elif base_family == "zero":
+            algo = algorithms.ZeroOptimizerAlgorithm(
+                optax.adam(1e-3), hierarchical=hierarchical)
             optimizer = None
         else:
             raise ValueError(f"unknown family {family!r}")
+        mesh = None
+        if hierarchical:
+            import jax
+
+            from ..parallel.mesh import build_mesh
+
+            n = len(jax.devices())
+            mesh = build_mesh({"inter": 2, "intra": n // 2})
         trainer = BaguaTrainer(
             loss_fn,
             optimizer,
             algo,
+            mesh=mesh,
             bucket_bytes=bucket_bytes,
             accum_steps=accum_steps,
             overlap=overlap,

@@ -432,13 +432,54 @@ class BaguaCommunicator:
 #: compile-size guard for the chunked rings (see :func:`ring_chunks_for`)
 MAX_RING_CHUNKS = env.get_max_ring_chunks()
 
+#: link classes of a hierarchical mesh's tiers: the ``intra`` axis rides
+#: ICI (slice-local interconnect), the ``inter`` axis rides DCN (the
+#: cross-slice data-center network, orders of magnitude less bandwidth).
+#: Per-tier chunk sizing targets different bytes per link class — a chunk
+#: sized for ICI is far too small to amortize a DCN hop.
+LINK_ICI = "ici"
+LINK_DCN = "dcn"
+
+
+def largest_divisor_leq(m: int, k: int) -> int:
+    """Largest divisor of ``m`` that is <= ``k`` (``m >= 1``, ``k >= 1``).
+
+    Direct O(sqrt(m)) divisor enumeration — the old ``while m % k: k -= 1``
+    scan was O(m) for prime per-rank blocks (a 1e6-element prime block
+    walked a million candidates on every host-side sizing call)."""
+    if k >= m:
+        return m
+    best = 1
+    i = 1
+    while i * i <= m:
+        if m % i == 0:
+            if i <= k and i > best:
+                best = i
+            j = m // i
+            if j <= k and j > best:
+                best = j
+        i += 1
+    return best
+
 
 def ring_chunks_for(numel: int, itemsize: int, nranks: int,
-                    chunk_bytes: Optional[int]) -> int:
+                    chunk_bytes: Optional[int],
+                    link_class: str = LINK_ICI) -> int:
     """Host-side sizing for the chunked ring collectives: the number of
     independent sub-collectives such that each carries ~``chunk_bytes`` of
     this rank's payload per hop (``ring_allreduce`` zero-pads indivisible
-    buffers, so the per-rank block is the padded one).  1 = monolithic."""
+    buffers, so the per-rank block is the padded one).  1 = monolithic.
+
+    ``chunk_bytes`` may be an int (one target for every link) or a mapping
+    ``{link_class: bytes}`` resolved by ``link_class`` — how the two tiers
+    of a hierarchical collective size their chunks against different
+    targets (:data:`LINK_ICI` vs :data:`LINK_DCN`).  A class absent from
+    the mapping means NO chunking for that class — falling back from a
+    missing tier knob to the link-agnostic target is
+    :meth:`AlgorithmContext.chunk_bytes_for`'s job, which resolves to an
+    int before calling here."""
+    if isinstance(chunk_bytes, dict):
+        chunk_bytes = chunk_bytes.get(link_class) or 0
     if not chunk_bytes or nranks <= 1:
         return 1
     m = -(-numel // nranks)  # per-rank block after the ring's padding
@@ -447,9 +488,8 @@ def ring_chunks_for(numel: int, itemsize: int, nranks: int,
     # capped: a tiny chunk_bytes against a 10 MiB bucket would otherwise
     # emit thousands of collectives per bucket and stall/OOM the compiler
     k = min(k, m, MAX_RING_CHUNKS)
-    while m % k:  # num_chunks must divide the per-rank block
-        k -= 1
-    return k
+    # num_chunks must divide the per-rank block
+    return largest_divisor_leq(m, k)
 
 
 class BaguaBackend:
@@ -486,9 +526,23 @@ _BACKENDS = {}
 
 
 def get_backend(model_name: str = "") -> BaguaBackend:
-    if model_name not in _BACKENDS:
-        _BACKENDS[model_name] = BaguaBackend()
-    return _BACKENDS[model_name]
+    """Per-process backend cache, keyed by model name AND validated against
+    the live global mesh: after an elastic resize or ``set_global_mesh`` the
+    cached backend's communicators span the DEAD topology — handing them
+    back would dispatch collectives over devices that left the world.  A
+    cached entry whose mesh is not the currently registered global mesh is
+    rebuilt (identity check: an elastic resize always constructs a new
+    ``Mesh``, and re-registering the same object is a no-op)."""
+    from .parallel.mesh import get_global_mesh_if_set
+
+    live = get_global_mesh_if_set()
+    backend = _BACKENDS.get(model_name)
+    if backend is not None and live is not None and backend.mesh is not live:
+        backend = None
+    if backend is None:
+        backend = BaguaBackend()
+        _BACKENDS[model_name] = backend
+    return backend
 
 
 _autotune_server = None

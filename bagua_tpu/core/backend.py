@@ -171,6 +171,8 @@ class BaguaTrainer:
         accum_steps: int = 1,
         overlap: Optional[str] = None,
         overlap_chunk_bytes: Optional[int] = None,
+        overlap_chunk_bytes_intra: Optional[int] = None,
+        overlap_chunk_bytes_inter: Optional[int] = None,
         flat_resident: Optional[str] = None,
         grad_guard: Optional[str] = None,
         grad_guard_budget: int = 3,
@@ -247,6 +249,16 @@ class BaguaTrainer:
         0 / env ``BAGUA_OVERLAP_CHUNK_BYTES``: keep the fused XLA
         collectives.  Only applies while the overlap scheduler is active,
         on single-axis comm worlds.
+
+        ``overlap_chunk_bytes_intra`` / ``overlap_chunk_bytes_inter``:
+        per-bandwidth-tier chunk targets for the hierarchical two-level
+        decomposition (docs/hierarchical.md) — the slice-local ICI stages
+        (and the flat single-axis ring) size against the intra target, the
+        cross-slice DCN stage against the inter one, because a chunk that
+        amortizes an ICI hop is far too small for a DCN hop.  Default 0 /
+        env ``BAGUA_OVERLAP_CHUNK_BYTES_INTRA`` / ``..._INTER``: fall back
+        to ``overlap_chunk_bytes`` for that tier.  Setting either is, like
+        the link-agnostic knob, an explicit opt-in to the ring path.
 
         ``flat_resident``: the flat-resident training-state layout
         (docs/flat_layout.md).  ``"on"``: params, gradients, and optimizer
@@ -379,6 +391,14 @@ class BaguaTrainer:
         self.overlap_chunk_bytes = int(
             env.get_overlap_chunk_bytes() if overlap_chunk_bytes is None
             else overlap_chunk_bytes
+        )
+        self.overlap_chunk_bytes_intra = int(
+            env.get_overlap_chunk_bytes_intra()
+            if overlap_chunk_bytes_intra is None else overlap_chunk_bytes_intra
+        )
+        self.overlap_chunk_bytes_inter = int(
+            env.get_overlap_chunk_bytes_inter()
+            if overlap_chunk_bytes_inter is None else overlap_chunk_bytes_inter
         )
         self.flat_resident = (
             flat_resident or env.get_flat_resident_mode()
@@ -564,6 +584,12 @@ class BaguaTrainer:
             overlap_chunk_bytes=(
                 self.overlap_chunk_bytes or None if overlap else None
             ),
+            intra_chunk_bytes=(
+                self.overlap_chunk_bytes_intra or None if overlap else None
+            ),
+            inter_chunk_bytes=(
+                self.overlap_chunk_bytes_inter or None if overlap else None
+            ),
             flat_resident=self._flat_resident,
         )
 
@@ -653,7 +679,16 @@ class BaguaTrainer:
         # collectives as open dataflow); an explicit chunk size is an
         # opt-in to the ring path at any accum.
         return self.algorithm.overlap_auto and (
-            self.accum_steps > 1 or bool(self.overlap_chunk_bytes)
+            self.accum_steps > 1 or self._any_chunk_bytes()
+        )
+
+    def _any_chunk_bytes(self) -> bool:
+        """Whether ANY ring chunk target is set (link-agnostic or per-tier)
+        — each is an explicit opt-in to the chunked ring path."""
+        return bool(
+            self.overlap_chunk_bytes
+            or self.overlap_chunk_bytes_intra
+            or self.overlap_chunk_bytes_inter
         )
 
     def _reorder_plan_for_overlap(self, state, batch) -> None:
@@ -1318,16 +1353,29 @@ class BaguaTrainer:
                 # jaxpr is unchanged) and record the launch ORDER and byte
                 # accounting of the streamed schedule.
                 if self._flat_resident:
-                    # flat-resident grads are already the bucket flats
-                    reduced = []
-                    for i, f in enumerate(grads["flats"]):
-                        b = plan.buckets[i]
+                    # flat-resident grads are already the bucket flats.
+                    # Launch order is bandwidth-tier-aware: on a two-tier
+                    # mesh with the hierarchical path active, DCN-dominant
+                    # buckets are streamed first so the slow link is busy
+                    # for the whole backward window; the spans record each
+                    # launch's tier + per-tier byte estimate so
+                    # obs/attribution can split device comm seconds into
+                    # ICI vs DCN.  Results assemble in plan order — issue
+                    # order never changes the numerics.
+                    hier = getattr(algo, "hierarchical", False)
+                    order = ctx.bucket_launch_order(hier)
+                    reduced = [None] * len(grads["flats"])
+                    for i in order:
+                        tiers = ctx.bucket_tier_bytes(i, hier)
                         with trace_span(
                             "trace/bucket_collective", bucket=i,
-                            bytes=int(b.padded_numel
-                                      * np.dtype(b.dtype).itemsize),
+                            bytes=tiers["bytes"], tier=tiers["tier"],
+                            ici_bytes=tiers["ici_bytes"],
+                            dcn_bytes=tiers["dcn_bytes"],
                         ):
-                            reduced.append(algo.reduce_bucket_grad(ctx, i, f))
+                            reduced[i] = algo.reduce_bucket_grad(
+                                ctx, i, grads["flats"][i]
+                            )
                     grads, algo_state = algo.grads_from_reduced(
                         ctx, reduced, grads, algo_state, step
                     )
@@ -1524,10 +1572,13 @@ class BaguaTrainer:
             self.algorithm.hierarchical,
             type(self.algorithm).__name__,
             overlap,
-            # chunk bytes only reach the traced program while overlap is
-            # active (_ctx nulls them otherwise) — keying the raw value
-            # would recompile bit-identical serialized steps
+            # chunk bytes (link-agnostic + per-tier) only reach the traced
+            # program while overlap is active (_ctx nulls them otherwise) —
+            # keying the raw values would recompile bit-identical
+            # serialized steps
             self.overlap_chunk_bytes if overlap else 0,
+            self.overlap_chunk_bytes_intra if overlap else 0,
+            self.overlap_chunk_bytes_inter if overlap else 0,
             # grad guard: "warn" and "abort" trace the same program (the
             # policy difference is host-side), "skip" adds the rewind
             # selects; armed traced faults compile into the step, so their
@@ -2354,6 +2405,14 @@ class BaguaTrainer:
             self.overlap = recommended.overlap
         if recommended.overlap_chunk_bytes:
             self.overlap_chunk_bytes = int(recommended.overlap_chunk_bytes)
+        if recommended.overlap_chunk_bytes_intra:
+            self.overlap_chunk_bytes_intra = int(
+                recommended.overlap_chunk_bytes_intra
+            )
+        if recommended.overlap_chunk_bytes_inter:
+            self.overlap_chunk_bytes_inter = int(
+                recommended.overlap_chunk_bytes_inter
+            )
         if recommended.buckets:
             named_by_name = {p.name: p for p in self._named_params}
             decl_buckets = [
@@ -2564,6 +2623,8 @@ class BaguaTrainer:
             bucket_size=self.bucket_bytes,
             overlap=self.overlap,
             overlap_chunk_bytes=int(self.overlap_chunk_bytes),
+            overlap_chunk_bytes_intra=int(self.overlap_chunk_bytes_intra),
+            overlap_chunk_bytes_inter=int(self.overlap_chunk_bytes_inter),
         )
 
     def _batch_spec(self) -> P:

@@ -66,6 +66,12 @@ class BaguaHyperparameter(BaseModel):
     overlap: str = ""
     #: chunked-ring sub-collective size in bytes (0 = keep current)
     overlap_chunk_bytes: int = 0
+    #: per-bandwidth-tier chunk targets for hierarchical two-level
+    #: collectives (docs/hierarchical.md): the slice-local ICI stages and
+    #: the cross-slice DCN stage size their ring chunks against different
+    #: bytes (0 = keep current / fall back to ``overlap_chunk_bytes``)
+    overlap_chunk_bytes_intra: int = 0
+    overlap_chunk_bytes_inter: int = 0
 
     def update(self, param_dict: dict) -> "BaguaHyperparameter":
         tmp = self.model_dump()

@@ -59,6 +59,16 @@ _declare("BAGUA_OVERLAP", "enum", "auto",
 _declare("BAGUA_OVERLAP_CHUNK_BYTES", "int", "0",
          "Target per-rank bytes of one independent ring sub-collective under "
          "the overlap scheduler; 0 keeps the fused XLA collectives.")
+_declare("BAGUA_OVERLAP_CHUNK_BYTES_INTRA", "int", "0",
+         "Per-tier ring chunk target for the slice-local ICI stages of the "
+         "hierarchical two-level collectives (and the flat single-axis "
+         "ring); 0 falls back to BAGUA_OVERLAP_CHUNK_BYTES.  See "
+         "docs/hierarchical.md.")
+_declare("BAGUA_OVERLAP_CHUNK_BYTES_INTER", "int", "0",
+         "Per-tier ring chunk target for the cross-slice DCN stage of the "
+         "hierarchical two-level collectives — size it larger than the ICI "
+         "target (a chunk that amortizes an ICI hop is far too small for a "
+         "DCN hop); 0 falls back to BAGUA_OVERLAP_CHUNK_BYTES.")
 _declare("BAGUA_FLAT_RESIDENT", "enum", "auto",
          "Flat-resident training state: keep params/grads/optimizer state "
          "as bucket-flat buffers across steps (`on`), keep the leaf pytree "
@@ -385,6 +395,20 @@ def get_overlap_chunk_bytes() -> int:
     """Target per-rank bytes of one independent ring sub-collective under
     the overlap scheduler; 0 (default) keeps the fused XLA collectives."""
     return env_int("BAGUA_OVERLAP_CHUNK_BYTES")
+
+
+def get_overlap_chunk_bytes_intra() -> int:
+    """Per-tier ring chunk target for the slice-local ICI stages of the
+    hierarchical two-level collectives; 0 (default) falls back to
+    :func:`get_overlap_chunk_bytes`."""
+    return env_int("BAGUA_OVERLAP_CHUNK_BYTES_INTRA")
+
+
+def get_overlap_chunk_bytes_inter() -> int:
+    """Per-tier ring chunk target for the cross-slice DCN stage of the
+    hierarchical two-level collectives; 0 (default) falls back to
+    :func:`get_overlap_chunk_bytes`."""
+    return env_int("BAGUA_OVERLAP_CHUNK_BYTES_INTER")
 
 
 def get_flat_resident_mode() -> str:

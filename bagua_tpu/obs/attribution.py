@@ -36,9 +36,29 @@ so it rides the per-rank obs summary → health beacon → fleet snapshot.
 from __future__ import annotations
 
 import logging
+import re
 from typing import Dict, List, Optional
 
 logger = logging.getLogger(__name__)
+
+#: slice-local (ICI) stages of a two-level schedule, by XLA instruction
+#: name; everything else on the wire of such a schedule is the cross-slice
+#: allreduce (or the scalar loss sync, which also crosses the boundary)
+_ICI_STAGE = re.compile(r"(reduce-scatter|all-gather)")
+
+
+def _contiguous_triples(wire: List[dict], per_step: int) -> bool:
+    """Whether the per-step occurrence sequence is (reduce-scatter,
+    allreduce, allgather) per bucket, contiguously — the shape the
+    positional per-bucket split requires.  Families that issue the gather
+    leg in a later phase (ZeRO's optimizer-update allgathers) interleave
+    differently; their per-bucket split must degrade, not mis-attribute."""
+    stage_rx = (re.compile(r"reduce-scatter"), re.compile(r"all-reduce"),
+                re.compile(r"all-gather"))
+    for i, ev in enumerate(wire):
+        if not stage_rx[(i % per_step) % 3].search(ev["name"]):
+            return False
+    return True
 
 __all__ = ["attribute_device_comm", "bucket_launches_from_ring",
            "UNAVAILABLE_RATIONALE"]
@@ -55,9 +75,12 @@ def bucket_launches_from_ring(spans: Optional[List[dict]] = None
     """The newest per-bucket launch schedule from the span ring: one entry
     per ``trace/bucket_collective`` span (deduped by bucket index, last
     trace wins — a recompile re-records the schedule), sorted by launch
-    order.  ``[{"bucket", "bytes"}, ...]``; [] when the overlap scheduler
-    never ran (serialized path has one fused comm stage, not per-bucket
-    launches)."""
+    order.  ``[{"bucket", "bytes", "tier", "ici_bytes", "dcn_bytes"},
+    ...]``; ``tier`` is ``"two_level"`` for the hierarchical decomposition
+    (three collectives per bucket: ICI reduce-scatter, DCN allreduce, ICI
+    allgather) and ``"flat"`` for one fused collective.  [] when the
+    overlap scheduler never ran (serialized path has one fused comm stage,
+    not per-bucket launches)."""
     if spans is None:
         from . import spans as _spans
 
@@ -72,6 +95,10 @@ def bucket_launches_from_ring(spans: Optional[List[dict]] = None
         by_bucket[int(attrs["bucket"])] = {
             "bucket": int(attrs["bucket"]),
             "bytes": int(attrs.get("bytes") or 0),
+            "tier": str(attrs.get("tier") or "flat"),
+            "ici_bytes": int(attrs.get("ici_bytes")
+                             or attrs.get("bytes") or 0),
+            "dcn_bytes": int(attrs.get("dcn_bytes") or 0),
             "t0": span.get("t0", 0.0),
         }
     out = sorted(by_bucket.values(), key=lambda e: e["t0"])
@@ -151,22 +178,58 @@ def attribute_device_comm(log_dir: str,
     # `all-reduce-done`, `all-reduce-done.1`, ... — match the infix, not
     # the suffix
     wire = [e for e in events if "-done" not in e["name"]]
+    two_level = bool(bucket_launches) and all(
+        l.get("tier") == "two_level" for l in bucket_launches)
+    if two_level and n_steps:
+        # tier totals by op NAME, not position: a two-level schedule's
+        # slice-local stages are reduce-scatter/all-gather instructions
+        # and its only cross-slice stage is the inter allreduce — name
+        # classification is robust to families that issue the gather legs
+        # outside the overlap window (ZeRO's optimizer-phase allgathers),
+        # where positional triple-grouping would mis-tier them.  The
+        # scalar loss allreduce (4 B, spans both axes) lands in the DCN
+        # class — it does cross the slice boundary.
+        ici_total = sum(e["dur_s"] for e in wire
+                        if _ICI_STAGE.search(e["name"]))
+        dcn_total = sum(e["dur_s"] for e in wire
+                        if not _ICI_STAGE.search(e["name"]))
+        record["comm_ici_s_per_step"] = round(ici_total / n_steps, 9)
+        record["comm_dcn_s_per_step"] = round(dcn_total / n_steps, 9)
+    #: device occurrences one bucket launch expands to, by tier shape:
+    #: flat = one fused collective; two_level = ICI reduce-scatter, DCN
+    #: allreduce, ICI allgather
+    ops_per_bucket = 3 if two_level and n_buckets else 1
     if n_steps and len(wire) % n_steps == 0 \
-            and len(wire) // n_steps == n_buckets:
+            and len(wire) // n_steps == n_buckets * ops_per_bucket \
+            and (ops_per_bucket == 1 or _contiguous_triples(wire,
+                                                            len(wire)
+                                                            // n_steps)):
         per_step = len(wire) // n_steps
-        totals = [0.0] * n_buckets
+        totals = [0.0] * per_step
         for i, ev in enumerate(wire):
             totals[i % per_step] += ev["dur_s"]
-        record["per_bucket"] = [
-            {"bucket": launch["bucket"], "bytes": launch["bytes"],
-             "device_comm_s": round(totals[pos] / n_steps, 9)}
-            for pos, launch in enumerate(bucket_launches)
-        ]
+        per_bucket = []
+        for pos, launch in enumerate(bucket_launches):
+            row = {"bucket": launch["bucket"], "bytes": launch["bytes"],
+                   "tier": launch.get("tier", "flat")}
+            if ops_per_bucket == 3:
+                rs, ar, ag = totals[3 * pos: 3 * pos + 3]
+                row["device_ici_s"] = round((rs + ag) / n_steps, 9)
+                row["device_dcn_s"] = round(ar / n_steps, 9)
+                row["device_comm_s"] = round((rs + ar + ag) / n_steps, 9)
+            else:
+                row["device_comm_s"] = round(totals[pos] / n_steps, 9)
+            per_bucket.append(row)
+        record["per_bucket"] = per_bucket
     else:
         record["per_bucket_rationale"] = (
             f"{len(wire)} device comm occurrences across "
-            f"{n_steps or '?'} steps do not map 1:1 onto {n_buckets} "
-            "bucket launches (fused or chunked collectives) — per-op "
-            "totals above are the attribution"
+            f"{n_steps or '?'} steps do not map "
+            f"{ops_per_bucket}:1 onto {n_buckets} "
+            "bucket launches as contiguous per-bucket stages (fused, "
+            "chunked, or phase-split collectives) — per-op totals above "
+            "are the attribution"
+            + (" (per-tier totals still reported: those classify by op "
+               "name, not position)" if two_level and n_steps else "")
         )
     return record

@@ -184,6 +184,16 @@ _declare("obs/perf_hints", "counter",
 _declare("obs/device_comm_s_per_step", "gauge",
          "Measured device communication seconds per step from the last "
          "closed profiler window (null-with-rationale on cpu-sim).")
+_declare("obs/device_comm_ici_s_per_step", "gauge",
+         "Slice-local (ICI-tier) share of the measured device comm seconds "
+         "per step: the reduce-scatter + allgather stages of the "
+         "hierarchical two-level decomposition (docs/hierarchical.md); "
+         "present only when the per-bucket positional match held on a "
+         "two-level launch schedule.")
+_declare("obs/device_comm_dcn_s_per_step", "gauge",
+         "Cross-slice (DCN-tier) share of the measured device comm seconds "
+         "per step: the inter-slice allreduce stage riding the slow link — "
+         "the number the two-level decomposition exists to shrink.")
 _declare("obs/device_overlap_fraction", "gauge",
          "Fraction of device comm time hidden under compute in the last "
          "closed profiler window (parse_xplane_overlap).")
@@ -322,6 +332,14 @@ def note_device_attribution(record: Dict[str, Any]) -> None:
         if record.get("overlap_fraction") is not None:
             counters.set_gauge("obs/device_overlap_fraction",
                                float(record["overlap_fraction"]))
+        # per-tier breakdown (hierarchical two-level schedules only): the
+        # DCN gauge is the slow-link cost the decomposition shrinks
+        if record.get("comm_ici_s_per_step") is not None:
+            counters.set_gauge("obs/device_comm_ici_s_per_step",
+                               float(record["comm_ici_s_per_step"]))
+        if record.get("comm_dcn_s_per_step") is not None:
+            counters.set_gauge("obs/device_comm_dcn_s_per_step",
+                               float(record["comm_dcn_s_per_step"]))
 
 
 def last_device_attribution() -> Optional[Dict[str, Any]]:
@@ -425,6 +443,14 @@ def local_obs_summary() -> Optional[dict]:
                 "comm_s_per_step")
             summary["device_overlap_fraction"] = attribution.get(
                 "overlap_fraction")
+            if attribution.get("comm_dcn_s_per_step") is not None:
+                # per-tier split of the comm seconds (two-level schedules):
+                # the coordinator's fleet view can see DCN seconds move out
+                # of the step when the hierarchical path lands
+                summary["device_comm_ici_s_per_step"] = attribution.get(
+                    "comm_ici_s_per_step")
+                summary["device_comm_dcn_s_per_step"] = attribution.get(
+                    "comm_dcn_s_per_step")
         else:
             # null-with-rationale, like trace_overlap's bench records
             summary["device_comm_s_per_step"] = None

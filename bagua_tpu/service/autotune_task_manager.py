@@ -141,6 +141,12 @@ class AutotuneTaskManager:
             overlap_chunk_bytes=(
                 last_hp.overlap_chunk_bytes if last_hp is not None else 0
             ),
+            overlap_chunk_bytes_intra=(
+                last_hp.overlap_chunk_bytes_intra if last_hp is not None else 0
+            ),
+            overlap_chunk_bytes_inter=(
+                last_hp.overlap_chunk_bytes_inter if last_hp is not None else 0
+            ),
         )
 
     def best_hyperparameters(

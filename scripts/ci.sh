@@ -15,8 +15,11 @@ echo "=== bagua-lint (AST rules + jaxpr collective consistency) ==="
 # Fails on any unsuppressed finding not in the shrink-only baseline (stale
 # baseline entries fail too — the baseline can only shrink), and proves
 # overlap-vs-serialized collective-multiset equality for the algorithm
-# families at accum_steps 1 and 4.  The historical torch-import gate is now
-# the `torch-import` rule.  See docs/analysis.md.
+# families at accum_steps 1 and 4 — including the hierarchical two-level
+# configs (family:hier on a 2-slice x 4-chip mesh: intra reduce-scatter,
+# inter allreduce on the 1/intra shard, intra allgather; ISSUE 11).  The
+# historical torch-import gate is now the `torch-import` rule.  See
+# docs/analysis.md and docs/hierarchical.md.
 JAX_PLATFORMS=cpu \
 python -m bagua_tpu.analysis bagua_tpu/ --baseline .bagua-lint-baseline.json
 

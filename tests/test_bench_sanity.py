@@ -107,6 +107,61 @@ def test_bench_flat_artifact_schema():
     assert "faster_path_by_config" in gate and gate["auto_default"]
 
 
+def test_bench_hierarchical_artifact_schema():
+    """BENCH_HIERARCHICAL.json (driver-visible artifact of
+    benchmarks/hierarchical_bench.py): the two-level decomposition's
+    acceptance signal — cross-slice (DCN-tier) bytes per step reduced to
+    ~1/intra_size of the flat path's, exact jaxpr byte accounting — plus
+    the interleaved-A/B honesty protocol on the throughput records and the
+    null-with-rationale device-time split on cpu-sim."""
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "BENCH_HIERARCHICAL.json")
+    assert os.path.exists(path), "run benchmarks/hierarchical_bench.py first"
+    records = json.load(open(path))
+    by_metric = {r["metric"]: r for r in records}
+
+    header = by_metric["hierarchical_bench_schema"]
+    assert header["schema"] == "bagua-bench-hierarchical-v1"
+    intra = header["mesh"]["intra"]
+    assert intra > 1
+
+    # the acceptance ratio, per family: two-tier DCN bytes ~ flat/intra.
+    # allreduce is EXACT 1/intra (pure shard); zero can be below (the flat
+    # path's gather legs all cross the boundary); bytegrad sits above (the
+    # codec's per-rank min/max scales do not shrink with the shard) but
+    # must still cut the slow link's bytes by >= 2x
+    for family in ("gradient_allreduce", "zero", "bytegrad"):
+        rec = by_metric[f"hierarchical_dcn_bytes_{family}"]
+        assert rec["intra_size"] == intra
+        assert rec["flat"]["dcn_bytes_per_step"] > 0
+        assert rec["two_tier"]["dcn_bytes_per_step"] > 0
+        if family == "gradient_allreduce":
+            assert rec["value"] == pytest.approx(1.0 / intra, rel=0.01), rec
+        else:
+            assert rec["value"] <= 0.5, rec
+        # the ICI tiers take over the bytes the slow link no longer moves
+        assert rec["two_tier"]["ici_bytes_per_step"] > \
+            rec["two_tier"]["dcn_bytes_per_step"]
+
+    speedups = [r for r in records
+                if r["metric"].startswith("hierarchical_speedup_")]
+    assert len(speedups) == 3
+    for rec in speedups:
+        assert isinstance(rec["per_trial_ratios"], list) and len(
+            rec["per_trial_ratios"]) >= 3
+        assert isinstance(rec["noise_bound"], bool)
+        assert rec["provenance"]  # cpu-sim honesty note
+
+    tier_dev = by_metric["hierarchical_device_tier_seconds"]
+    if tier_dev["device_comm_dcn_s_per_step"] is None:
+        # cpu-sim: null-with-rationale, never a fabricated number
+        assert tier_dev["rationale"]
+    assert "obs/device_comm_dcn_s_per_step" in tier_dev["gauges"]
+
+
 def test_chaos_drill_artifact_schema():
     """CHAOS_DRILL.json (driver-visible artifact of scripts/chaos_drill.py):
     the committed record must cover the full fault matrix with every fault

@@ -227,6 +227,13 @@ def test_bucket_launches_from_ring():
          "attrs": {"bucket": 0, "bytes": 30}},
     ]
     out = bucket_launches_from_ring(spans)
-    assert out == [{"bucket": 1, "bytes": 10}, {"bucket": 0, "bytes": 30}]
+    # tier defaults: spans without tier attrs are flat single-collective
+    # launches (ici_bytes = the full operand, nothing on DCN)
+    assert out == [
+        {"bucket": 1, "bytes": 10, "tier": "flat", "ici_bytes": 10,
+         "dcn_bytes": 0},
+        {"bucket": 0, "bytes": 30, "tier": "flat", "ici_bytes": 30,
+         "dcn_bytes": 0},
+    ]
     obs_spans.recorder.clear()
     assert bucket_launches_from_ring() == []
