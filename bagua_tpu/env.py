@@ -220,6 +220,36 @@ _declare("BAGUA_OBS_ANOMALY_WARMUP", "int", "16",
 _declare("BAGUA_OBS_ANOMALY_THRESHOLD", "float", "5.0",
          "Robust-z threshold (MAD multiples) a step's raw cadence must "
          "exceed over the rolling median to count as anomalous.")
+# -- serving plane (docs/serving.md) --
+_declare("BAGUA_SERVE_MAX_SLOTS", "int", "8",
+         "Batch slots of the continuous-batching inference engine: the "
+         "static batch dimension of the compiled decode tick.  Requests "
+         "join/evict mid-batch without recompiling; more slots raise "
+         "throughput at the cost of per-tick latency and pool pressure.")
+_declare("BAGUA_SERVE_PAGE_SIZE", "int", "16",
+         "Tokens per KV-cache page of the serving engine's paged pool; "
+         "must divide the model's max_seq_len.  Smaller pages waste less "
+         "memory on short tails, larger pages gather more contiguously.")
+_declare("BAGUA_SERVE_NUM_PAGES", "int", "0",
+         "Page-pool capacity per layer (including the 2 reserved "
+         "zero/trash pages).  0 (default) auto-sizes to max_slots full-"
+         "length sequences — no preemption pressure; set lower to "
+         "oversubscribe HBM and rely on the queue-then-preempt "
+         "backpressure instead.")
+_declare("BAGUA_SERVE_QUEUE_DEPTH", "int", "256",
+         "Admission-queue depth of the serving engine; submissions beyond "
+         "it raise ServeQueueFull (explicit shed/retry backpressure, "
+         "never an OOM).")
+_declare("BAGUA_SERVE_PREFILL_CHUNK", "int", "8",
+         "Prompt tokens one chunked-prefill call consumes for a single "
+         "slot (at most one such call per scheduler tick, so long prompts "
+         "cannot stall running decodes); 1 disables the chunked program — "
+         "prompts then stream through the batched tick one token per "
+         "tick, generate()-style.")
+_declare("BAGUA_SERVE_TICK_IDLE_S", "float", "0.001",
+         "Scheduler idle-poll granularity in seconds: how long one wait "
+         "slice lasts while the engine is empty and ahead of the next "
+         "trace arrival (the wall it books as batch_formation_idle).")
 _declare("BAGUA_ELASTIC_FENCE_UNHEALTHY", "int", "0",
          "Coordinator-side health fence: expel a member whose heartbeat "
          "health payload reports at least this many unhealthy events "
@@ -584,6 +614,37 @@ def get_obs_anomaly_warmup() -> int:
 
 def get_obs_anomaly_threshold() -> float:
     return env_float("BAGUA_OBS_ANOMALY_THRESHOLD")
+
+
+def get_serve_max_slots() -> int:
+    """Batch slots of the continuous-batching serving engine."""
+    return env_int("BAGUA_SERVE_MAX_SLOTS")
+
+
+def get_serve_page_size() -> int:
+    """Tokens per KV-cache page of the serving page pool."""
+    return env_int("BAGUA_SERVE_PAGE_SIZE")
+
+
+def get_serve_num_pages() -> int:
+    """Page-pool capacity per layer (0 = auto-size to max_slots
+    full-length sequences)."""
+    return env_int("BAGUA_SERVE_NUM_PAGES")
+
+
+def get_serve_queue_depth() -> int:
+    """Admission-queue depth of the serving engine."""
+    return env_int("BAGUA_SERVE_QUEUE_DEPTH")
+
+
+def get_serve_prefill_chunk() -> int:
+    """Prompt tokens per chunked-prefill call (1 disables chunking)."""
+    return env_int("BAGUA_SERVE_PREFILL_CHUNK")
+
+
+def get_serve_tick_idle_s() -> float:
+    """Scheduler idle-poll granularity in seconds."""
+    return env_float("BAGUA_SERVE_TICK_IDLE_S")
 
 
 def get_elastic_store_addr() -> Optional[str]:

@@ -17,14 +17,13 @@ chain), else temperature-scaled categorical.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["decode_model", "generate", "generate_tp",
-           "clear_tp_generate_cache"]
+           "clear_generate_cache", "clear_tp_generate_cache"]
 
 
 def decode_model(model):
@@ -74,13 +73,25 @@ def _generate_core(model, params, prompt, max_new_tokens, rng, temperature):
     return toks[prompt_len - 1:].T  # [b, max_new_tokens]
 
 
-_generate_jit = partial(jax.jit, static_argnums=(0, 3))(_generate_core)
-# Bounded LRU of compiled tp-decode programs: long-lived serving processes
-# that vary prompt budgets or meshes must not accumulate executables (and
-# pin their mesh/device objects) forever.  8 distinct (model, mesh, budget,
+# Bounded LRU of compiled decode programs, keyed by the generate signature
+# (model config, batch, prompt_len, max_new_tokens) — the same discipline
+# as the tp cache below: long-lived serving processes that vary batch
+# shapes or budgets must not accumulate executables forever, and a bare
+# `jax.jit` module global could never free them.  Evictions just recompile.
+_GEN_CACHE_MAX = 8
+_GEN_CACHE: "dict" = {}  # insertion-ordered; move-to-end on hit
+
+# Same policy for the tensor-parallel decode programs (these additionally
+# pin their mesh/device objects).  8 distinct (model, mesh, budget,
 # sharding) signatures cover realistic serving; evictions just recompile.
 _TP_GEN_CACHE_MAX = 8
 _TP_GEN_CACHE: "dict" = {}  # insertion-ordered; move-to-end on hit
+
+
+def clear_generate_cache() -> None:
+    """Drop every compiled single-host decode program (frees the
+    executables); the next :func:`generate` call recompiles."""
+    _GEN_CACHE.clear()
 
 
 def clear_tp_generate_cache() -> None:
@@ -124,8 +135,24 @@ def generate(
         raise ValueError("temperature sampling needs an rng key")
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    return _generate_jit(model, params, prompt, int(max_new_tokens), rng,
-                         jnp.float32(temperature))
+    # memoized per (model, batch, prompt_len, max_new) signature, exactly
+    # like generate_tp: repeated calls reuse the compiled scan instead of
+    # re-dispatching through a fresh trace, and the LRU bounds the
+    # executables a long-lived serving process can accumulate
+    from ..utils import lru_get_or_build
+
+    n = int(max_new_tokens)
+
+    def build():
+        def run(params, prompt, rng, temperature, _model=model, _n=n):
+            return _generate_core(_model, params, prompt, _n, rng,
+                                  temperature)
+
+        return jax.jit(run)
+
+    fn = lru_get_or_build(_GEN_CACHE, _GEN_CACHE_MAX,
+                          (model, b, prompt_len, n), build)
+    return fn(params, prompt, rng, jnp.float32(temperature))
 
 
 def generate_tp(
@@ -208,18 +235,21 @@ def generate_tp(
     # decode scan every request (the _EAGER_CACHE lesson, communication.py)
     # key includes the spec VALUES, not just the tree structure — a custom
     # tp_param_dim mapping the same params to different dims must recompile
+    from ..utils import lru_get_or_build
+
     flat_specs, spec_tree = jax.tree_util.tree_flatten(pspecs)
-    cache_key = (model, mesh, tp_axis, n, spec_tree, tuple(flat_specs))
-    fn = _TP_GEN_CACHE.pop(cache_key, None)
-    if fn is None:
+
+    def build():
         def per_shard(p, toks, key, temp):
             return _generate_core(model, p, toks, n, key, temp)
 
-        fn = jax.jit(shard_map(
+        return jax.jit(shard_map(
             per_shard, mesh=mesh, in_specs=(pspecs, P(), P(), P()),
             out_specs=P(), check_vma=False,
         ))
-    _TP_GEN_CACHE[cache_key] = fn  # re-insert = move to most-recent
-    while len(_TP_GEN_CACHE) > _TP_GEN_CACHE_MAX:
-        _TP_GEN_CACHE.pop(next(iter(_TP_GEN_CACHE)))
+
+    fn = lru_get_or_build(
+        _TP_GEN_CACHE, _TP_GEN_CACHE_MAX,
+        (model, mesh, tp_axis, n, spec_tree, tuple(flat_specs)), build,
+    )
     return fn(params, prompt, rng, jnp.float32(temperature))

@@ -59,6 +59,22 @@ class TransformerConfig:
     #: through models/generate.py — a decode=True config cannot train
     #: (single-token attention, mutable cache).
     decode: bool = False
+    #: paged KV-cache decode (the serving plane, docs/serving.md): instead
+    #: of one dense ``[b, max_seq_len, h, d]`` cache per layer, each layer
+    #: keeps a shared **page pool** ``[num_pages, page_size, h, d]`` and
+    #: requests map positions onto pool pages through a per-slot block
+    #: table passed via the ``slots`` call argument — requests of different
+    #: lengths share the pool while the compiled program stays one static
+    #: shape.  ``page_size`` must divide ``max_seq_len``; 0 keeps the dense
+    #: decode cache.  Only meaningful with ``decode=True``.
+    page_size: int = 0
+    #: page-pool capacity (pages per layer) for paged decode.  Pages 0 and
+    #: 1 are reserved by convention: page 0 is the permanent ZERO page
+    #: (unallocated block-table entries gather zeros, exactly like the
+    #: dense cache's untouched rows) and page 1 is the TRASH page
+    #: (masked writes of inactive slots land there) — the serving
+    #: allocator never hands either out.
+    num_pages: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -109,6 +125,15 @@ def causal_attention(q, k, v, dtype):
     return flash_attention(q, k, v, dtype, causal=True)
 
 
+#: reserved page ids of the paged decode pool (see
+#: ``TransformerConfig.num_pages``): ZERO_PAGE is never written (gathers as
+#: zeros for unallocated block-table entries), TRASH_PAGE absorbs the
+#: masked writes of inactive slots
+ZERO_PAGE = 0
+TRASH_PAGE = 1
+RESERVED_PAGES = 2
+
+
 def _tp_active(cfg) -> bool:
     return (
         cfg.tp_axis is not None and cfg.tp_size > 1
@@ -121,7 +146,7 @@ class Attention(nn.Module):
     attn_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, slots=None):
         cfg = self.cfg
         assert cfg.n_heads % cfg.tp_size == 0, (cfg.n_heads, cfg.tp_size)
         h, d = cfg.n_heads // cfg.tp_size, cfg.head_dim  # local heads
@@ -134,7 +159,9 @@ class Attention(nn.Module):
             param_dtype=cfg.param_dtype, use_bias=False,
         )
         q, k, v = dense("q")(x), dense("k")(x), dense("v")(x)
-        if cfg.decode:
+        if cfg.decode and cfg.page_size > 0:
+            o = self._paged_decode_attend(q, k, v, slots)
+        elif cfg.decode:
             o = self._decode_attend(q, k, v)
         else:
             fn = self.attn_fn or causal_attention
@@ -190,6 +217,80 @@ class Attention(nn.Module):
         weights = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", weights, cached_v.value)
 
+    def _paged_decode_attend(self, q, k, v, slots):
+        """Attention against this layer's **page pool** (the serving
+        plane's paged KV-cache).  ``q/k/v`` are ``[b, s, h, d]`` where b is
+        the engine's slot count and s is 1 (a decode tick) or the static
+        prefill chunk; ``slots`` carries the shared per-slot state the
+        scheduler maintains host-side:
+
+        * ``block_table`` int32 ``[b, max_seq_len // page_size]`` — page id
+          of each logical page of each slot (unallocated entries point at
+          the reserved ZERO page),
+        * ``lengths`` int32 ``[b]`` — tokens already cached per slot (the
+          positions this call writes are ``lengths .. lengths + s - 1``),
+        * ``active`` bool ``[b]`` — inactive slots' writes are routed to
+          the reserved TRASH page (their outputs are garbage the engine
+          ignores).
+
+        The gather reconstructs, per slot, exactly the dense
+        ``[b, max_seq_len, h, d]`` cache `_decode_attend` would hold
+        (pages in position order, unallocated rows zero), and the
+        score/mask/softmax/value math is the same expression — so greedy
+        decode through the pool is bit-identical to the dense path
+        (pinned in ``tests/test_serve.py``)."""
+        cfg = self.cfg
+        b, s, h, d = q.shape
+        assert cfg.page_size > 0 and cfg.max_seq_len % cfg.page_size == 0, (
+            cfg.page_size, cfg.max_seq_len)
+        assert cfg.num_pages > RESERVED_PAGES, cfg.num_pages
+        pages_per_slot = cfg.max_seq_len // cfg.page_size
+        is_initialized = self.has_variable("cache", "pool_key")
+        pool_k = self.variable(
+            "cache", "pool_key", jnp.zeros,
+            (cfg.num_pages, cfg.page_size, h, d), cfg.dtype,
+        )
+        pool_v = self.variable(
+            "cache", "pool_value", jnp.zeros,
+            (cfg.num_pages, cfg.page_size, h, d), cfg.dtype,
+        )
+        if not is_initialized:
+            return v  # init trace: single token attends only to itself
+        if slots is None:
+            raise ValueError(
+                "paged decode (page_size > 0) needs the `slots` call "
+                "argument (block_table / lengths / active)"
+            )
+        lengths = slots["lengths"]          # [b]
+        block_table = slots["block_table"]  # [b, pages_per_slot]
+        active = slots["active"]            # [b]
+        # destination (page, offset) of each written position; inactive
+        # slots write to the trash page so the pool stays clean
+        positions = lengths[:, None] + jnp.arange(s)[None, :]   # [b, s]
+        dest_page = jnp.take_along_axis(
+            block_table, positions // cfg.page_size, axis=1
+        )                                                       # [b, s]
+        dest_page = jnp.where(active[:, None], dest_page, TRASH_PAGE)
+        offsets = positions % cfg.page_size
+        pool_k.value = pool_k.value.at[dest_page, offsets].set(
+            k.astype(cfg.dtype))
+        pool_v.value = pool_v.value.at[dest_page, offsets].set(
+            v.astype(cfg.dtype))
+        # gather each slot's pages back into position order: elementwise
+        # equal to the dense cache (zero page rows = untouched zeros)
+        def view(pool):  # [b, max_seq_len, h, d]
+            return pool[block_table].reshape(b, cfg.max_seq_len, h, d)
+
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, view(pool_k.value),
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(d).astype(jnp.float32)
+        # causal per slot: position lengths+i attends to keys <= lengths+i
+        mask = jnp.arange(cfg.max_seq_len)[None, None, :] <= positions[:, :, None]
+        scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, view(pool_v.value))
+
 
 class MLPBlock(nn.Module):
     cfg: TransformerConfig
@@ -223,10 +324,13 @@ class Block(nn.Module):
     mlp: Optional[Callable[[], nn.Module]] = None  # MoE drops in here
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, slots=None):
         cfg = self.cfg
         y = RMSNorm(cfg.dtype, cfg.param_dtype, name="attn_norm")(x)
-        x = x + Attention(cfg, self.attn_fn, name="attn")(y)
+        attn = Attention(cfg, self.attn_fn, name="attn")
+        # dense/training call sites keep their exact one-arg form (the
+        # goldens pin those programs); only paged decode threads slots
+        x = x + (attn(y) if slots is None else attn(y, slots))
         y = RMSNorm(cfg.dtype, cfg.param_dtype, name="mlp_norm")(x)
         mlp = self.mlp() if self.mlp is not None else MLPBlock(cfg, name="mlp")
         x = x + mlp(y)
@@ -242,8 +346,13 @@ class TransformerLM(nn.Module):
     head: bool = True  # False: return final hidden states (encoder trunk)
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, slots=None):
         cfg = self.cfg
+        if slots is not None and not (cfg.decode and cfg.page_size > 0):
+            raise ValueError(
+                "`slots` is only meaningful for paged decode configs "
+                "(decode=True, page_size > 0)"
+            )
         x = nn.Embed(
             cfg.vocab_size, cfg.d_model, name="embed",
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -253,21 +362,36 @@ class TransformerLM(nn.Module):
             (cfg.max_seq_len, cfg.d_model), cfg.param_dtype,
         )
         s = tokens.shape[1]
-        start = 0
-        if cfg.sp_axis is not None and _axis_bound(cfg.sp_axis):
-            start = jax.lax.axis_index(cfg.sp_axis) * s
-        if cfg.decode:
-            # autoregressive position counter (mirrors the attention cache;
-            # same init-pass guard — see Attention._decode_attend)
-            advance = self.has_variable("cache", "pos_index")
-            pos_index = self.variable(
-                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
-            )
-            if advance:
-                start = pos_index.value
-                pos_index.value = start + s
-        pos_slice = jax.lax.dynamic_slice_in_dim(pos, start, s, axis=0)
-        x = x + pos_slice[None].astype(cfg.dtype)
+        if cfg.decode and cfg.page_size > 0:
+            # paged decode: every slot sits at its OWN position (continuous
+            # batching admits requests mid-flight), so the position comes
+            # from the scheduler's per-slot lengths, not a shared counter.
+            # During init (no slots yet) position 0 stands in.
+            if slots is None:
+                pos_ids = jnp.zeros((tokens.shape[0], s), jnp.int32)
+            else:
+                pos_ids = (slots["lengths"][:, None]
+                           + jnp.arange(s, dtype=jnp.int32)[None, :])
+            # pos[idx] equals the dense path's dynamic_slice row for the
+            # same position — elementwise identical, per slot
+            pos_slice = jnp.take(pos, pos_ids, axis=0)  # [b, s, d_model]
+            x = x + pos_slice.astype(cfg.dtype)
+        else:
+            start = 0
+            if cfg.sp_axis is not None and _axis_bound(cfg.sp_axis):
+                start = jax.lax.axis_index(cfg.sp_axis) * s
+            if cfg.decode:
+                # autoregressive position counter (mirrors the attention
+                # cache; same init-pass guard — see Attention._decode_attend)
+                advance = self.has_variable("cache", "pos_index")
+                pos_index = self.variable(
+                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                )
+                if advance:
+                    start = pos_index.value
+                    pos_index.value = start + s
+            pos_slice = jax.lax.dynamic_slice_in_dim(pos, start, s, axis=0)
+            x = x + pos_slice[None].astype(cfg.dtype)
         if cfg.remat:
             from ..utils import remat_wrap
 
@@ -276,7 +400,8 @@ class TransformerLM(nn.Module):
             block_cls = Block
         for i in range(cfg.n_layers):
             mlp = self.mlp_factory(i) if self.mlp_factory is not None else None
-            x = block_cls(cfg, self.attn_fn, mlp, name=f"block_{i}")(x)
+            blk = block_cls(cfg, self.attn_fn, mlp, name=f"block_{i}")
+            x = blk(x) if slots is None else blk(x, slots)
         x = RMSNorm(cfg.dtype, cfg.param_dtype, name="final_norm")(x)
         if not self.head:
             return x.astype(jnp.float32)

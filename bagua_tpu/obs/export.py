@@ -51,7 +51,12 @@ logger = logging.getLogger(__name__)
 #: them from here)
 LEDGER_CLASSES = (
     "productive_step", "compile", "state_migration", "checkpoint",
-    "rendezvous", "catchup_sync", "rewind", "stall", "idle_other",
+    "rendezvous", "catchup_sync", "rewind", "stall",
+    # serving classes (docs/serving.md): prefill/decode are a serving
+    # replica's goodput; batch-formation idle and weight loads are its
+    # named badput
+    "prefill", "decode", "batch_formation_idle", "weight_load",
+    "idle_other",
 )
 
 
@@ -208,9 +213,11 @@ _declare("obs/ledger/wall_s", "gauge",
          "rank (the conservation denominator: classes sum to this within "
          "1%).")
 _declare("obs/goodput_fraction", "gauge",
-         "Fraction of this rank's ledger wall spent in productive steps — "
-         "the fleet's headline efficiency number (everything else is "
-         "badput with a named class).")
+         "Fraction of this rank's ledger wall spent making forward "
+         "progress — productive train steps, plus a serving replica's "
+         "prefill/decode walls (the GOODPUT_CLASSES) — the fleet's "
+         "headline efficiency number (everything else is badput with a "
+         "named class).")
 _declare("obs/mfu", "gauge",
          "Model FLOPS utilization of the current compiled step: cached "
          "cost-model flops / measured step cadence / peak silicon FLOP/s "
@@ -229,6 +236,55 @@ _declare("obs/hbm_peak_bytes", "gauge",
 _declare("obs/hbm_headroom_bytes", "gauge",
          "bytes_limit minus the live peak from the last memory poll — the "
          "capacity-planning margin (real TPU only).")
+
+
+# -- serving plane (docs/serving.md) --
+_declare("serve/requests_admitted", "counter",
+         "Requests admitted from the queue into an engine batch slot "
+         "(continuous batching: admission happens mid-batch, every tick).")
+_declare("serve/requests_completed", "counter",
+         "Requests that produced their full output and were evicted.")
+_declare("serve/requests_preempted", "counter",
+         "Slots preempted on page-pool exhaustion (pages reclaimed, the "
+         "request re-queued for recompute — the backpressure path).")
+_declare("serve/requests_rejected", "counter",
+         "Submissions refused at the admission-queue depth cap "
+         "(ServeQueueFull).")
+_declare("serve/ticks", "counter",
+         "Scheduler ticks executed (one batched decode step each, when "
+         "any slot is active).")
+_declare("serve/prefill_tokens", "counter",
+         "Prompt tokens written into the paged KV-cache (teacher-forced "
+         "tick feeds + chunked prefill).")
+_declare("serve/prefill_chunks", "counter",
+         "Chunked-prefill program invocations (BAGUA_SERVE_PREFILL_CHUNK "
+         "tokens of one slot per call).")
+_declare("serve/decode_tokens", "counter",
+         "Output tokens sampled — decode ticks plus the chunked-prefill "
+         "call that produces a request's first token.  Counts WORK, not "
+         "delivery: a preempted request's recomputed tokens count each "
+         "time they are sampled (equals delivered output tokens only "
+         "when serve/requests_preempted is 0).")
+_declare("serve/pool_exhausted", "counter",
+         "Page-allocation attempts that found the pool empty (each one "
+         "queues or preempts — never crashes).")
+_declare("serve/weight_loads", "counter",
+         "Integrity-verified serving weight loads "
+         "(serve.loader.load_serving_params).")
+_declare("serve/queue_depth", "gauge",
+         "Requests currently waiting in the admission queue.")
+_declare("serve/active_slots", "gauge",
+         "Batch slots currently running a request.")
+_declare("serve/pages_in_use", "gauge",
+         "KV-cache pages currently allocated (excludes the 2 reserved "
+         "pages).")
+_declare("serve/ttft_last_s", "gauge",
+         "Time-to-first-token of the most recently started request "
+         "(submit -> first sampled token); percentiles live in "
+         "BENCH_SERVE.json.")
+_declare("serve/tpot_last_s", "gauge",
+         "Time-per-output-token of the most recently completed request "
+         "(after its first token).")
 
 
 def is_registered(name: str) -> bool:
@@ -461,11 +517,13 @@ def local_obs_summary() -> Optional[dict]:
     # HBM footprint/headroom — all host-side accounting
     ledger_report = _ledger().report()
     if ledger_report is not None:
+        from .ledger import BADPUT_CLASSES  # lazy: ledger imports from us
+
         summary["goodput_fraction"] = ledger_report["goodput_fraction"]
         summary["badput"] = {
             cls: round(s, 3)
             for cls, s in ledger_report["classes"].items()
-            if cls != "productive_step" and s > 0
+            if cls in BADPUT_CLASSES and s > 0
         }
         summary["worst_badput_class"] = ledger_report["worst_badput_class"]
     if mfu:

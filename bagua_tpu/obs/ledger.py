@@ -16,13 +16,19 @@ process's wall-clock lands in exactly one **ledger class** —
 * ``stall`` — injected ``step.straggle`` stalls (drills; a real slow host
   shows up as dilated ``productive_step`` windows the anomaly detector
   flags instead)
+* ``prefill`` / ``decode`` — a SERVING replica's forward progress: the
+  engine's chunked-prefill and decode-tick walls (docs/serving.md)
+* ``batch_formation_idle`` — a serving replica waiting for arrivals with
+  an empty batch (the continuous-batching scheduler's named idle)
+* ``weight_load`` — integrity-verified serving weight loads
 * ``idle_other`` — everything else (data loading, eval, host work between
   steps), computed as the remainder so the classes always sum to the wall
 
 — the goodput/badput lens MegaScale (arXiv 2402.15627) uses to diagnose
 10k-accelerator fleets, and the score signal ROADMAP's autotune-v2 wants.
-``goodput_fraction = productive_step / wall``; every other class is badput
-with a name.
+``goodput_fraction = sum(GOODPUT_CLASSES) / wall`` — a training rank's
+productive steps plus a serving replica's prefill/decode; every other
+class is badput with a name.
 
 Feeding is piggybacked on machinery that already exists: the span tracer
 (``ckpt/*``, ``elastic/rendezvous``, ``async/*``, ``step/build`` spans map
@@ -66,7 +72,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 logger = logging.getLogger(__name__)
 
 __all__ = [
-    "LEDGER_CLASSES", "BADPUT_CLASSES", "SPAN_CLASS_MAP",
+    "LEDGER_CLASSES", "GOODPUT_CLASSES", "BADPUT_CLASSES", "SPAN_CLASS_MAP",
     "DRILL_BADPUT_EXPECTATIONS", "GoodputLedger",
     "ledger", "install", "PEAK_TFLOPS_BF16", "PEAK_HBM_GBPS",
     "peak_flops_for_device_kind", "EFFICIENCY_SCHEMA", "validate_efficiency",
@@ -78,8 +84,16 @@ __all__ = [
 #: source of truth for the metric names)
 from .export import LEDGER_CLASSES  # noqa: E402
 
+#: the classes that ARE forward progress: a training rank's productive
+#: steps, a serving replica's prefill/decode walls (docs/serving.md) —
+#: ``goodput_fraction`` sums these, so the headline number means the same
+#: thing for both kinds of process (a class the process never feeds
+#: contributes zero)
+GOODPUT_CLASSES = ("productive_step", "prefill", "decode")
+
 #: the classes that are NOT forward progress
-BADPUT_CLASSES = tuple(c for c in LEDGER_CLASSES if c != "productive_step")
+BADPUT_CLASSES = tuple(c for c in LEDGER_CLASSES
+                       if c not in GOODPUT_CLASSES)
 
 #: span name -> ledger class: the spans that already bracket the
 #: non-productive walls.  Outermost-mapped-span-wins (ckpt/verify nests
@@ -95,6 +109,13 @@ SPAN_CLASS_MAP = {
     "elastic/rendezvous": "rendezvous",
     "async/negotiate": "catchup_sync",
     "async/catchup": "catchup_sync",
+    # serving plane (docs/serving.md): the engine's prefill/decode walls
+    # are serving goodput; weight loads are badput with a name.
+    # batch_formation_idle is fed directly by the engine's run loop (the
+    # wait-for-arrivals wall has no span to ride).
+    "serve/prefill": "prefill",
+    "serve/decode": "decode",
+    "serve/weight_load": "weight_load",
 }
 
 #: chaos-drill name -> the badput class its defense path must FEED: the
@@ -275,11 +296,11 @@ class GoodputLedger:
             classes["idle_other"] = round(max(0.0, wall - explicit), 6)
             badput = {c: classes[c] for c in BADPUT_CLASSES if classes[c] > 0}
             worst = max(badput, key=badput.get) if badput else None
+            goodput = sum(classes[c] for c in GOODPUT_CLASSES)
             return {
                 "wall_s": round(wall, 6),
                 "classes": classes,
-                "goodput_fraction": round(
-                    classes["productive_step"] / wall, 6),
+                "goodput_fraction": round(goodput / wall, 6),
                 "badput_s": round(sum(badput.values()), 6),
                 "worst_badput_class": worst,
                 "step_windows": self._step_windows,

@@ -191,6 +191,22 @@ class StatisticalAverage:
         return self.get_records_mean(total_sec) * total_sec
 
 
+def lru_get_or_build(cache: dict, max_entries: int, key, build):
+    """The bounded insertion-ordered LRU idiom shared by the compiled-
+    program caches (``models.generate``'s signature caches,
+    ``serve.engine``'s program cache): pop-on-hit + re-insert moves the
+    entry to most-recent, ``build()`` fills a miss, and eviction drops the
+    oldest entries beyond ``max_entries`` (an evicted program just
+    recompiles on its next use)."""
+    value = cache.pop(key, None)
+    if value is None:
+        value = build()
+    cache[key] = value
+    while len(cache) > max_entries:
+        cache.pop(next(iter(cache)))
+    return value
+
+
 def find_free_port(low: int = 20000, high: int = 65000) -> int:
     import socket
 

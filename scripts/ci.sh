@@ -52,6 +52,25 @@ python -m bagua_tpu.obs.ledger "$OBS_TMP/export" \
   --flight "$OBS_TMP/dumps" --check
 rm -rf "$OBS_TMP"
 
+echo "=== serve smoke (continuous-batching engine, short synthetic trace) ==="
+# The serving plane end-to-end on the 8-dev cpu-sim image: weights loaded
+# through the integrity-verified serving loader, a short Poisson trace
+# through the paged-KV continuous-batching engine, the continuous-vs-
+# static A/B, and the schema validation serve_bench runs before writing
+# (an invalid record exits non-zero).  The committed full-trace
+# BENCH_SERVE.json is schema-gated in tests/test_bench_sanity.py.
+SERVE_TMP="$(mktemp -d)"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+BAGUA_OBS_EXPORT_DIR="$SERVE_TMP/export" BAGUA_OBS_EXPORT_INTERVAL_S=1 \
+python benchmarks/serve_bench.py --smoke --out "$SERVE_TMP/BENCH_SERVE.json"
+
+echo "=== goodput ledger over the serve smoke's metrics export ==="
+# Conservation must hold with the serving classes aboard (prefill/decode
+# as serving goodput, batch_formation_idle/weight_load as named badput):
+# every class second accounted, classes sum to wall within 1%.
+python -m bagua_tpu.obs.ledger "$SERVE_TMP/export" --check
+rm -rf "$SERVE_TMP"
+
 echo "=== bench trend sentinel (advisory) ==="
 # Quick probe re-measured with the committed artifact's own protocol,
 # compared noise-bound-aware; refreshes BENCH_TREND.json (schema-gated in

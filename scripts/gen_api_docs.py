@@ -62,6 +62,11 @@ MODULES = [
     "bagua_tpu.models.vgg",
     "bagua_tpu.models.transformer",
     "bagua_tpu.models.generate",
+    "bagua_tpu.serve",
+    "bagua_tpu.serve.cache",
+    "bagua_tpu.serve.engine",
+    "bagua_tpu.serve.loader",
+    "bagua_tpu.serve.schema",
     "bagua_tpu.ops.flash_attention",
     "bagua_tpu.ops.gmm",
     "bagua_tpu.ops.tiles",

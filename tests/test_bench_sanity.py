@@ -355,6 +355,66 @@ def test_efficiency_artifact_schema():
     assert footprint_rec["value"] == fp["total_bytes"]
 
 
+def test_serve_bench_artifact_schema():
+    """BENCH_SERVE.json (driver-visible artifact of
+    benchmarks/serve_bench.py): the serving plane's acceptance record —
+    TTFT/TPOT percentiles from the Poisson trace, the continuous-vs-static
+    throughput A/B under the _ab.py honesty protocol with the >=1.3x gate
+    (or an honest noise_bound flag + in-file provenance), and the serving
+    goodput-ledger classes proven FED (prefill, decode, weight_load all
+    carry real wall; regenerate with
+    `JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+    python benchmarks/serve_bench.py`)."""
+    import json
+    import os
+
+    from bagua_tpu.serve import SERVE_SPEEDUP_GATE, validate_serve_bench
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "BENCH_SERVE.json")
+    assert os.path.exists(path), "run benchmarks/serve_bench.py first"
+    records = json.load(open(path))
+    assert validate_serve_bench(records) == [], validate_serve_bench(records)
+    by_metric = {r["metric"]: r for r in records}
+
+    header = by_metric["serve_bench_schema"]
+    assert header["platform"] == "cpu-sim" and header["n_devices"] == 8
+    assert header["smoke"] is False, "commit the full trace, not --smoke"
+    # mixed lengths are the point of the trace (uniform traffic would
+    # flatter static batching)
+    lo, hi = header["trace"]["output_range"]
+    assert hi - lo >= 8, header["trace"]
+
+    # the acceptance ratio: continuous >= 1.3x static token throughput on
+    # the mixed-length backlog, or an honest noise-bound flag
+    speedup = by_metric["serve_continuous_over_static_throughput"]
+    assert speedup["value"] >= SERVE_SPEEDUP_GATE or \
+        speedup["noise_bound"], speedup
+    assert len(speedup["per_trial_ratios"]) >= 3
+    assert speedup["provenance"]
+    assert speedup["gate"] == SERVE_SPEEDUP_GATE
+
+    # latency percentiles ordered sanely
+    lat = by_metric["serve_latency"]
+    for field in ("ttft_s", "tpot_s"):
+        pct = lat[field]
+        assert pct["p50"] <= pct["p90"] <= pct["p99"], (field, pct)
+
+    # the serving ledger classes were fed by real walls (the engine's
+    # spans + the integrity-verified weight load)
+    led = by_metric["serve_ledger_classes"]
+    for cls in ("prefill", "decode", "weight_load"):
+        assert led["classes"][cls] > 0, (cls, led)
+    assert 0.0 < led["goodput_fraction"] <= 1.0
+    # the engine's own counters rode along: every admitted request
+    # completed (the backpressure paths queue/preempt, never drop)
+    counts = header["counters"]
+    assert counts["serve/requests_completed"] >= \
+        header["trace"]["n_latency_requests"]
+    assert counts.get("serve/requests_admitted", 0) >= \
+        counts["serve/requests_completed"]
+
+
 def test_straggler_bench_artifact_schema():
     """BENCH_STRAGGLER.json (driver-visible artifact of
     benchmarks/straggler_bench.py): under the seeded 10× single-rank

@@ -135,6 +135,36 @@ def test_tp_generation_validation():
         generate_tp(model, params, prompt, 4, mesh)  # cfg carries no tp
 
 
+def test_generate_cache_memoizes_and_is_bounded(monkeypatch):
+    """Repeated generate() calls with one (config, batch, prompt_len,
+    max_new) signature must reuse the SAME compiled program (no re-trace);
+    the cache is a bounded LRU with an explicit clear, mirroring
+    generate_tp's."""
+    import bagua_tpu.models.generate as G
+
+    model, params, prompt = _model_and_params(key=20)
+    G.clear_generate_cache()
+    out1 = generate(model, params, prompt, 4)
+    assert len(G._GEN_CACHE) == 1
+    fn = next(iter(G._GEN_CACHE.values()))
+    out2 = generate(model, params, prompt, 4)
+    assert next(iter(G._GEN_CACHE.values())) is fn  # memoized, not rebuilt
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+    monkeypatch.setattr(G, "_GEN_CACHE_MAX", 2)
+    for budget in (5, 6):
+        generate(model, params, prompt, budget)
+    assert len(G._GEN_CACHE) == 2
+    budgets = sorted(k[3] for k in G._GEN_CACHE)
+    assert budgets == [5, 6], "oldest signature (budget 4) must be evicted"
+    # the evicted signature still works (recompiles) and returns the same
+    # greedy continuation
+    out3 = generate(model, params, prompt, 4)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out3))
+    G.clear_generate_cache()
+    assert not G._GEN_CACHE
+
+
 def test_tp_gen_cache_is_bounded(monkeypatch):
     """The compiled tp-decode cache must not grow without bound (serving
     processes vary budgets/prompt shapes); eviction is LRU."""
