@@ -10,14 +10,21 @@ from .gradient_allreduce import GradientAllReduceAlgorithm  # noqa: F401
 from .q_adam import QAdamAlgorithm, QAdamOptState  # noqa: F401
 from .zero import ZeroOptimizerAlgorithm  # noqa: F401
 
-#: Families the autotuner may switch between at a check-in.  Stateless
-#: replicated trainer-owned-optimizer families (gradient_allreduce,
-#: bytegrad) swap freely; QAdam is switchable through the trainer's
-#: state-migration adapter (its momenta are param-shaped, so they can be
-#: adopted from an adam-family optax state — or start from zeros — and its
-#: warmup contract is re-anchored at the switch step; see
-#: ``BaguaTrainer._prepare_state_migration``).  Gossip/sharded families
-#: change the TrainState layout irreversibly and must be chosen up front.
+#: Families the autotuner (and the fleet autopilot's escalation ladder,
+#: through the same recommendation path) may switch between at a check-in.
+#: Stateless replicated trainer-owned-optimizer families
+#: (gradient_allreduce, bytegrad) swap freely; QAdam is switchable through
+#: the trainer's state-migration adapter (its momenta are param-shaped, so
+#: they can be adopted from an adam-family optax state — or start from
+#: zeros — and its warmup contract is re-anchored at the switch step; see
+#: ``BaguaTrainer._prepare_state_migration``).  Async model averaging
+#: crosses the replicated<->stacked state boundary and rides
+#: ``BaguaTrainer._prepare_replication_migration`` (replicated state is
+#: stacked per rank on the way in; a synchronous catch-up average
+#: collapses the rows on the way out) — but only from families that
+#: neither own the optimizer nor keep flat-resident state, on pure-dp
+#: meshes.  Sharded-opt-state families (ZeRO) change the TrainState
+#: layout irreversibly and must be chosen up front.
 SWITCHABLE_ALGORITHMS = {
     "gradient_allreduce": lambda hierarchical: GradientAllReduceAlgorithm(
         hierarchical=hierarchical
@@ -27,5 +34,11 @@ SWITCHABLE_ALGORITHMS = {
     # compressed phase must begin well inside the scoring window
     "qadam": lambda hierarchical: QAdamAlgorithm(
         warmup_steps=20, hierarchical=hierarchical
+    ),
+    # mid-run entry needs no warmup (the run is already warmed up) and no
+    # hierarchical flag (averaging rounds are whole-model allreduces);
+    # period calibration starts fresh at the switch step
+    "async": lambda hierarchical: AsyncModelAverageAlgorithm(
+        warmup_steps=0
     ),
 }

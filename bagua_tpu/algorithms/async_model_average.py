@@ -115,6 +115,7 @@ def _agree_max(value: float, watchdog=None, label: str = "async-negotiate") -> f
 
 
 class AsyncModelAverageAlgorithm(Algorithm):
+    name = "async"
     replicated_params = False
     #: async steps run on stale local weights — a slow peer binds this
     #: family only at its negotiated boundaries (which call the

@@ -12,10 +12,12 @@ calls out as required for the elastic workload.
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import os
 import threading
 import weakref
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import jax
 
@@ -24,6 +26,98 @@ logger = logging.getLogger(__name__)
 # live managers, so emergency paths (watchdog exit) can flush queued async
 # saves instead of losing them to os._exit skipping atexit handlers
 _LIVE_MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+# ---- storage quarantine (docs/autopilot.md) -------------------------------
+#
+# Repeated integrity failures / fallback restores on one checkpoint
+# directory mean the STORAGE under it is rotting — continuing to save there
+# burns wall clock writing checkpoints that will not verify at the next
+# restore.  The autopilot's ckpt_integrity rule (or an operator, via
+# BAGUA_CKPT_QUARANTINED_PATHS) quarantines the path: every live
+# BaguaCheckpointManager on it redirects subsequent SAVES to a
+# `<dir>.redirect` sibling, while RESTORES keep walking both directories
+# (newest-first across the union — reads of already-verified old steps are
+# exactly what quarantine must not break).
+
+_QUARANTINE_LOCK = threading.Lock()
+_QUARANTINED: set = set()
+_QUARANTINE_SEEDED = False
+
+
+def _normalize_storage_path(path: str) -> str:
+    p = str(path)
+    if "://" in p:  # gs:// etc. — keep verbatim minus trailing slashes
+        return p.rstrip("/")
+    return os.path.abspath(p).rstrip("/")
+
+
+def _seed_quarantine_from_env() -> None:
+    """One-time seed from ``BAGUA_CKPT_QUARANTINED_PATHS`` — the channel
+    the elastic launcher uses to carry the autopilot's quarantine verdicts
+    into respawned workers at the restart boundary."""
+    global _QUARANTINE_SEEDED
+    if _QUARANTINE_SEEDED:
+        return
+    _QUARANTINE_SEEDED = True
+    from . import env as _env
+
+    for p in _env.get_ckpt_quarantined_paths():
+        _QUARANTINED.add(_normalize_storage_path(p))
+
+
+def quarantine_storage_path(path: str) -> bool:
+    """Quarantine a checkpoint directory (idempotent; returns True when
+    newly quarantined).  Live managers on the path redirect their next
+    save; future managers resolve the redirect at construction."""
+    with _QUARANTINE_LOCK:
+        _seed_quarantine_from_env()
+        p = _normalize_storage_path(path)
+        if p in _QUARANTINED:
+            return False
+        _QUARANTINED.add(p)
+    logger.warning(
+        "checkpoint storage QUARANTINED: %s — saves redirect to %s",
+        p, redirect_directory(p),
+    )
+    return True
+
+
+def is_quarantined(path: str) -> bool:
+    with _QUARANTINE_LOCK:
+        _seed_quarantine_from_env()
+        return _normalize_storage_path(path) in _QUARANTINED
+
+
+def quarantined_paths() -> List[str]:
+    with _QUARANTINE_LOCK:
+        _seed_quarantine_from_env()
+        return sorted(_QUARANTINED)
+
+
+def clear_quarantine() -> None:
+    """Forget every quarantine (test isolation)."""
+    global _QUARANTINE_SEEDED
+    with _QUARANTINE_LOCK:
+        _QUARANTINED.clear()
+        _QUARANTINE_SEEDED = True
+
+
+def redirect_directory(path: str) -> str:
+    """Where saves for a quarantined ``path`` land."""
+    return _normalize_storage_path(path) + ".redirect"
+
+
+def active_directory(path: str) -> str:
+    """Resolve a requested checkpoint directory through the quarantine
+    registry (chasing redirect-of-redirect up to a small bound — a
+    redirect that rots too gets quarantined like any other path)."""
+    p = _normalize_storage_path(path)
+    for _ in range(4):
+        if not is_quarantined(p):
+            return p
+        p = redirect_directory(p)
+    return p
 
 
 class CheckpointIntegrityError(RuntimeError):
@@ -116,15 +210,46 @@ class BaguaCheckpointManager:
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
-        self.directory = str(directory)
-        options = ocp.CheckpointManagerOptions(
+        #: the directory the CALLER asked for — quarantine verdicts name
+        #: this path; ``self.directory`` is the ACTIVE (possibly
+        #: redirected) one
+        self.requested_directory = str(directory)
+        self.directory = active_directory(self.requested_directory)
+        if self.directory != _normalize_storage_path(
+                self.requested_directory):
+            logger.warning(
+                "checkpoint directory %s is quarantined; using %s",
+                self.requested_directory, self.directory,
+            )
+        self._options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=async_save,
         )
-        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._mgr = ocp.CheckpointManager(self.directory,
+                                          options=self._options)
+        #: read-only managers over earlier directories in the quarantine
+        #: redirect chain (oldest first) — a mid-life redirect appends the
+        #: displaced manager, and a manager CONSTRUCTED on an already-
+        #: quarantined path wires the whole chain here, so restores always
+        #: keep walking the verified pre-quarantine history
+        self._fallbacks: List[Tuple[Any, str]] = []
+        chain = _normalize_storage_path(self.requested_directory)
+        while chain != self.directory:
+            self._fallbacks.append(
+                (ocp.CheckpointManager(chain, options=self._options), chain)
+            )
+            chain = redirect_directory(chain)
         self._async_save = bool(async_save)
         self._integrity = bool(integrity)
+        # fleet view: the storage path this rank saves to rides the obs
+        # summary, so the autopilot can name WHICH path to quarantine
+        try:
+            from .obs.export import note_ckpt_directory
+
+            note_ckpt_directory(self.directory)
+        except Exception:  # noqa: BLE001 - obs is never load-bearing here
+            pass
         # layout sidecars whose orbax save is not yet known-durable:
         # written only once the async save finishes (wait()/close()/next
         # save), so a crash mid-save can't leave a sidecar pointing at a
@@ -157,6 +282,7 @@ class BaguaCheckpointManager:
         or in :meth:`wait`/:meth:`close` — never ahead of its checkpoint."""
         from .obs.spans import trace_span
 
+        self._ensure_active_manager()
         with trace_span("ckpt/save", step=int(step),
                         async_save=self._async_save):
             saved = self._mgr.save(
@@ -194,6 +320,65 @@ class BaguaCheckpointManager:
             if not self._async_save:
                 self._run_chaos_corruption()
         return saved
+
+    def _ensure_active_manager(self) -> None:
+        """Re-resolve the quarantine registry: when the active directory
+        was quarantined since the last call (the autopilot's
+        ``quarantine_storage`` action, in-process), flush what the old
+        manager has queued, keep it around READ-ONLY (its verified history
+        must stay restorable), and point saves at the redirect."""
+        active = active_directory(self.requested_directory)
+        if active == self.directory:
+            return
+        logger.warning(
+            "checkpoint storage quarantine: redirecting saves %s -> %s "
+            "(restores keep walking both)", self.directory, active,
+        )
+        try:
+            self.wait()  # flush queued async saves + sidecars on old storage
+        except Exception as e:  # noqa: BLE001 - rotting storage may throw
+            logger.warning("flush of quarantined checkpoint dir failed: %s",
+                           e)
+        # APPEND, never overwrite: a redirect-of-redirect must keep the
+        # original directory's verified history in the restore walk too
+        self._fallbacks.append((self._mgr, self.directory))
+        self.directory = active
+        self._mgr = self._ocp.CheckpointManager(active,
+                                                options=self._options)
+        try:
+            from .obs.export import note_ckpt_directory
+
+            note_ckpt_directory(self.directory)
+        except Exception:  # noqa: BLE001
+            pass
+
+    @contextlib.contextmanager
+    def _using(self, mgr, directory: str):
+        """Temporarily point this manager's restore path at another
+        (manager, directory) pair — how the newest-first integrity walk
+        reaches the pre-quarantine history without changing the
+        ``restore_one(step)`` contract ``BaguaTrainer.restore_checkpoint``
+        also relies on."""
+        if mgr is self._mgr:
+            yield
+            return
+        prev = (self._mgr, self.directory)
+        self._mgr, self.directory = mgr, directory
+        try:
+            yield
+        finally:
+            self._mgr, self.directory = prev
+
+    def _candidate_steps(self) -> List[Tuple[int, Any, str]]:
+        """(step, manager, directory) restore candidates, newest-first;
+        at equal steps the active directory shadows every fallback, and a
+        newer link of the redirect chain shadows an older one."""
+        out = {int(s): (self._mgr, self.directory)
+               for s in self._mgr.all_steps()}
+        for mgr, d in reversed(self._fallbacks):
+            for s in mgr.all_steps():
+                out.setdefault(int(s), (mgr, d))
+        return [(s,) + out[s] for s in sorted(out, reverse=True)]
 
     def _run_chaos_corruption(self) -> None:
         """Apply any armed ``ckpt.write`` fault to steps whose orbax files
@@ -250,7 +435,12 @@ class BaguaCheckpointManager:
             logger.debug("layout sidecar pruning skipped: %s", e)
 
     def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+        latest = self._mgr.latest_step()
+        for mgr, _ in self._fallbacks:
+            old = mgr.latest_step()
+            if old is not None and (latest is None or int(old) > int(latest)):
+                latest = old
+        return latest
 
     def _layout_path(self, step: int):
         # epath (an orbax dependency) resolves gs://, s3:// etc. — a raw
@@ -432,7 +622,14 @@ class BaguaCheckpointManager:
         Layout mismatches (``expect_metadata``) are configuration errors,
         not corruption — they raise immediately in both modes.
         """
+        self._ensure_active_manager()
         if step is not None:
+            for s, mgr, d in self._candidate_steps():
+                if s == int(step):
+                    with self._using(mgr, d):
+                        return self._restore_step(
+                            int(step), state_like, expect_metadata, mesh
+                        )
             return self._restore_step(
                 int(step), state_like, expect_metadata, mesh
             )
@@ -449,15 +646,15 @@ class BaguaCheckpointManager:
         restore cannot drift from the manager's."""
         from .faults import inject as _inject
 
-        candidates = sorted(
-            (int(s) for s in self._mgr.all_steps()), reverse=True
-        )
+        self._ensure_active_manager()
+        candidates = self._candidate_steps()
         if not candidates:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
         last_err: Optional[Exception] = None
-        for i, s in enumerate(candidates):
+        for i, (s, mgr, d) in enumerate(candidates):
             try:
-                result = restore_one(s)
+                with self._using(mgr, d):
+                    result = restore_one(s)
             except CheckpointIntegrityError as e:
                 from .telemetry import counters
 
@@ -609,3 +806,5 @@ class BaguaCheckpointManager:
         self._flush_pending_layouts()
         self._run_chaos_corruption()
         self._mgr.close()
+        for mgr, _ in self._fallbacks:
+            mgr.close()

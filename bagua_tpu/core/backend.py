@@ -2482,6 +2482,29 @@ class BaguaTrainer:
                     current, target,
                 )
                 return
+        new_replicated = (
+            self._user_algorithms[target].replicated_params
+            if target in self._user_algorithms
+            else SWITCHABLE_ALGORITHMS[target](False).replicated_params
+        )
+        if old_algorithm.replicated_params != new_replicated:
+            # replicated <-> stacked (allreduce <-> async): the state
+            # migration below re-lays the whole TrainState out; refuse the
+            # combinations it does not cover
+            if old_algorithm.owns_optimizer or new_owns:
+                logger.info(
+                    "autotune: cannot switch %s -> %s — a replication-"
+                    "boundary switch cannot also cross the optimizer-"
+                    "ownership boundary", current, target,
+                )
+                return
+            if self.expert_axis is not None or self._shard_axis is not None:
+                logger.info(
+                    "autotune: cannot switch %s -> %s — replication-"
+                    "boundary switches need a pure data-parallel mesh",
+                    current, target,
+                )
+                return
         logger.info("autotune: switching algorithm %s -> %s", current, target)
         if target in self._user_algorithms:
             # switching BACK to a family the user configured: reuse their
@@ -2494,6 +2517,17 @@ class BaguaTrainer:
                 bool(recommended.is_hierarchical_reduce)
             )
         self._prepare_state_migration(old_algorithm, self.algorithm)
+        self._prepare_replication_migration(old_algorithm, self.algorithm)
+        if hasattr(old_algorithm, "reset_schedule"):
+            # leaving a scheduled family: drop its in-flight round (it was
+            # launched against the stacked layout being migrated away) and
+            # forget the negotiated period
+            old_algorithm.reset_schedule()
+        if hasattr(self.algorithm, "reset_schedule"):
+            # entering (or re-entering) a scheduled family mid-run: the
+            # averaging period re-calibrates against the CURRENT cadence,
+            # and no stale pending round survives from a previous stint
+            self.algorithm.reset_schedule()
         if not recommended.buckets:
             # rebuild the plan under the new family's alignment (ByteGrad
             # pads buckets to the world size); skipped when the caller is
@@ -2555,6 +2589,73 @@ class BaguaTrainer:
                 )
 
             self._queue_state_migration(from_owned)
+
+    def _prepare_replication_migration(self, old, new) -> None:
+        """Queue a replicated <-> stacked TrainState migration for the
+        next ``train_step`` when a family switch crosses the replication
+        boundary (gradient_allreduce/bytegrad <-> async model averaging).
+        The switch itself is a re-jit — the new family's name/compile_key
+        select a fresh compiled step through the step-cache key — and this
+        migration converts the live buffers to the layout that step's
+        shard_map specs expect.
+
+        To a stacked (gossip) family: every rank's row adopts the
+        replicated copy — the rows start bit-identical, exactly as
+        ``init`` would build them.  Back to a replicated family: a
+        synchronous catch-up average collapses the (possibly diverged)
+        rows — the same consensus the async family's bounded-staleness cap
+        forces, so the switch point has the semantics of one extra
+        catch-up sync.  Integer leaves (step counters) advance in lockstep
+        and reduce with MAX: an exact consensus, where integer AVG is not.
+        The caller (:meth:`_maybe_switch_algorithm`) has already refused
+        flat-resident state, optimizer-ownership crossings, and
+        model-parallel meshes."""
+        if old.replicated_params == new.replicated_params:
+            return
+        mesh, specs = self.mesh, P(self.dp_axes)
+        ctx = self._ctx(self._plan)
+
+        if not new.replicated_params:
+
+            def migrate(state: TrainState) -> TrainState:
+                logger.info(
+                    "replication migration: replicated -> per-rank stacked "
+                    "(%s)", type(new).__name__,
+                )
+
+                def stack_fn(p, o, a):
+                    return _stack_tree(p), _stack_tree(o), _stack_tree(a)
+
+                p, o, a = jax.jit(shard_map(
+                    stack_fn, mesh=mesh, in_specs=(P(), P(), P()),
+                    out_specs=(specs, specs, specs), check_vma=False,
+                ))(state.params, state.opt_state, state.algo_state)
+                return TrainState(state.step, p, o, a)
+        else:
+
+            def migrate(state: TrainState) -> TrainState:
+                logger.info(
+                    "replication migration: stacked -> replicated via "
+                    "catch-up average (%s)", type(new).__name__,
+                )
+
+                def avg_fn(p, o, a):
+                    def avg(x):
+                        x = x[0]
+                        if jnp.issubdtype(x.dtype, jnp.inexact):
+                            return ctx.comm.allreduce(x, ReduceOp.AVG)
+                        return ctx.comm.allreduce(x, ReduceOp.MAX)
+
+                    return (jax.tree.map(avg, p), jax.tree.map(avg, o),
+                            jax.tree.map(avg, a))
+
+                p, o, a = jax.jit(shard_map(
+                    avg_fn, mesh=mesh, in_specs=(specs, specs, specs),
+                    out_specs=(P(), P(), P()), check_vma=False,
+                ))(state.params, state.opt_state, state.algo_state)
+                return TrainState(state.step, p, o, a)
+
+        self._queue_state_migration(migrate)
 
     def _autotune_step(self, state):
         from ..communication import get_hyperparameters_service_client

@@ -167,7 +167,8 @@ def _health_beacon_paths(args) -> List[str]:
     ]
 
 
-def build_env(args, local_rank: int, spec=None) -> dict:
+def build_env(args, local_rank: int, spec=None,
+              quarantined_ckpt_paths=None) -> dict:
     """Reference ``set_bagua_env`` (run.py:578-600) + rendezvous env.
 
     ``spec`` (elastic mode): the round's renegotiated
@@ -235,6 +236,14 @@ def build_env(args, local_rank: int, spec=None) -> dict:
             # beacons and carries them to the coordinator
             BAGUA_ELASTIC_HEALTH_FILE=_health_beacon_path(args, local_rank),
         )
+    if quarantined_ckpt_paths:
+        # autopilot storage-quarantine verdicts reach respawned workers at
+        # the restart boundary: their checkpoint managers seed the
+        # quarantine registry from this variable and redirect saves.
+        # Newline-separated — os.pathsep would split gs:// URIs apart
+        env["BAGUA_CKPT_QUARANTINED_PATHS"] = "\n".join(
+            str(p) for p in quarantined_ckpt_paths
+        )
     if args.simulate_cpu_devices:
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_PLATFORM_NAME"] = "cpu"
@@ -248,13 +257,17 @@ def build_env(args, local_rank: int, spec=None) -> dict:
     return env
 
 
-def spawn_gang(args, spec=None) -> List[subprocess.Popen]:
+def spawn_gang(args, spec=None,
+               quarantined_ckpt_paths=None) -> List[subprocess.Popen]:
     cmd_prefix = [] if args.no_python else [sys.executable, "-u"]
     procs = []
     for local_rank in range(args.nproc_per_node):
         cmd = cmd_prefix + [args.training_script] + args.training_script_args
         procs.append(
-            subprocess.Popen(cmd, env=build_env(args, local_rank, spec))
+            subprocess.Popen(cmd, env=build_env(
+                args, local_rank, spec,
+                quarantined_ckpt_paths=quarantined_ckpt_paths,
+            ))
         )
     return procs
 
@@ -525,29 +538,63 @@ def publish_health_fence(client, epoch: int, tracker, unhealthy) -> str:
     return reason
 
 
-def _maybe_write_fleet_snapshot(spec, tracker) -> None:
+def _maybe_write_fleet_snapshot(spec, tracker, want_record=False):
     """Coordinator-side fleet view: merge every member's latest heartbeat
-    health payload into the ``BAGUA_OBS_FLEET_OUT`` snapshot (no-op when
-    unset; exception-free — the caller is the monitor loop)."""
+    health payload into one ``bagua-obs-fleet-v1`` record; written to
+    ``BAGUA_OBS_FLEET_OUT`` when set, and RETURNED — the autopilot
+    (``want_record=True``) consumes the same record the snapshot file
+    carries (one merge, one truth).  With neither consumer the merge is
+    skipped entirely (the pre-autopilot no-op monitor tick).
+    Exception-free (None on failure) — the caller is the monitor loop."""
     out = _env.get_obs_fleet_out()
-    if not out:
-        return
+    if not out and not want_record:
+        return None
     try:
-        from ..obs.export import write_fleet_snapshot
+        from ..obs.export import build_fleet_record, write_fleet_snapshot
 
-        write_fleet_snapshot(
-            out, spec.epoch,
+        record = build_fleet_record(
+            spec.epoch,
             {nid: tracker.health_of(nid) for nid in spec.ranks},
         )
+        if out:
+            write_fleet_snapshot(out, spec.epoch, record=record)
+        return record
     except Exception as e:  # noqa: BLE001 - monitoring must not die on obs
         logger.debug("fleet snapshot not written: %s", e)
+        return None
 
 
-def monitor_elastic(args, procs, client, spec, coordinator, tracker) -> int:
+def publish_autopilot_stop(client, epoch: int, action, nodes) -> str:
+    """Convert an autopilot ``fence``/``resize`` action into the
+    ``health_fenced`` stop event — the SAME epoch/resize machinery lease
+    expiry and the chronic-health fence ride (the fenced node's launcher
+    exits, survivors regroup at n-1) — and leave the post-mortem artifact
+    naming the action and its evidence.  Returns the stop reason.  Shared
+    by :func:`monitor_elastic` and the chaos autopilot drills, so the
+    drilled path IS the production path."""
+    from ..elastic import membership as mb
+    from ..obs.recorder import dump_flight_record
+
+    reason = f"autopilot {action.kind} ({action.rule}): {action.reason}"
+    client.publish_stop(
+        epoch, mb.STOP_HEALTH, nodes[0], reason, rejoin=False, nodes=nodes,
+    )
+    dump_flight_record(
+        "health_fence", reason=reason,
+        extra={"nodes": [int(n) for n in nodes],
+               "autopilot_action": action.to_json()},
+    )
+    return reason
+
+
+def monitor_elastic(args, procs, client, spec, coordinator, tracker,
+                    autopilot=None) -> int:
     """Monitor one elastic attempt.  Every launcher: watch local workers +
     the per-epoch stop flag.  The coordinator additionally: expire silent
-    members' leases and scan for standby joiners (scale-up requests), each
-    of which it converts into a stop event the whole gang observes."""
+    members' leases, scan for standby joiners (scale-up requests) — each
+    converted into a stop event the whole gang observes — and, when the
+    autopilot is on, feed every fleet snapshot to the policy engine and
+    actuate its fence/resize verdicts through the same stop machinery."""
     from ..elastic import membership as mb
 
     epoch = spec.epoch
@@ -605,7 +652,32 @@ def monitor_elastic(args, procs, client, spec, coordinator, tracker) -> int:
                             mb.STOP_LEASE_EXPIRED, expired[0], reason,
                             rejoin=False, nodes=expired,
                         )
-                    _maybe_write_fleet_snapshot(spec, tracker)
+                    fleet_record = _maybe_write_fleet_snapshot(
+                        spec, tracker, want_record=autopilot is not None)
+                    if autopilot is not None and fleet_record is not None:
+                        # the policy engine evaluates the SAME merged view
+                        # the snapshot file carries; it actuates the
+                        # side-channel kinds (retune hints, quarantine)
+                        # itself and hands control-flow kinds back —
+                        # fence/resize must raise this loop's gang stop
+                        for action in autopilot.observe_snapshot(
+                                fleet_record):
+                            if autopilot.config.mode != "act":
+                                continue
+                            if action.kind in ("fence", "resize"):
+                                nodes = [int(n) for n in (action.target
+                                                          or [])
+                                         if int(n) in spec.ranks]
+                                if not nodes:
+                                    continue
+                                reason = publish_autopilot_stop(
+                                    client, epoch, action, nodes)
+                                autopilot.note_actuated(action)
+                                kill_gang(procs)
+                                raise _GangStop(
+                                    mb.STOP_HEALTH, nodes[0], reason,
+                                    rejoin=False, nodes=nodes,
+                                )
                     unhealthy = tracker.unhealthy_members()
                     if unhealthy:
                         reason = publish_health_fence(
@@ -692,6 +764,7 @@ def run_elastic(args) -> int:
         store = _RestartStore(args)
         client = mb.MembershipClient(store, args.node_rank, args.max_nnodes)
         coordinator = None
+        autopilot = None
         if is_coord:
             coordinator = ElasticCoordinator(
                 client, args.min_nnodes, args.max_nnodes,
@@ -699,6 +772,26 @@ def run_elastic(args) -> int:
                 join_window_s=args.join_window,
                 timeout_s=args.restart_barrier_timeout,
             )
+            if _env.get_autopilot_mode() != "off":
+                # ONE engine across every epoch of this coordinator's
+                # life; its policy state additionally persists through the
+                # restart store, so a RELAUNCHED coordinator resumes with
+                # cooldowns/rung/quarantines intact instead of re-firing a
+                # cooled-down action
+                from ..autopilot import (
+                    AutopilotEngine,
+                    default_engine_actuators,
+                )
+
+                autopilot = AutopilotEngine(
+                    actuators=default_engine_actuators(
+                        autotune_addr=(f"{args.master_addr}:"
+                                       f"{args.bagua_service_port}"),
+                    ),
+                    store=store,
+                )
+                logger.info("fleet autopilot: %s mode",
+                            autopilot.config.mode)
         epoch = 0
         restarts_used = 0
         expect = None
@@ -784,10 +877,20 @@ def run_elastic(args) -> int:
                     # own workers' health must still reach the fence
                     observe_only_ids=[args.node_rank],
                 )
-            procs = spawn_gang(args, spec)
+            # EVERY launcher (not just the coordinator's) reads the
+            # act-mode engine's actuated storage-quarantine verdicts off
+            # the shared restart store: the node whose workers write to
+            # the rotting storage is usually NOT the coordinator node
+            from ..autopilot.engine import read_actuated_quarantines
+
+            procs = spawn_gang(
+                args, spec,
+                quarantined_ckpt_paths=read_actuated_quarantines(store),
+            )
             try:
                 rc = monitor_elastic(
-                    args, procs, client, spec, coordinator, tracker)
+                    args, procs, client, spec, coordinator, tracker,
+                    autopilot=autopilot)
                 try:
                     client.publish_done(spec.epoch)
                     if is_coord:

@@ -257,6 +257,71 @@ _declare("BAGUA_ELASTIC_FENCE_UNHEALTHY", "int", "0",
          "boundaries).  The fenced node's launcher exits instead of "
          "rejoining; survivors resize through the normal epoch machinery.  "
          "0 (default) disables fencing.")
+# -- fleet autopilot (docs/autopilot.md) --
+_declare("BAGUA_AUTOPILOT", "enum", "off",
+         "Closed-loop fleet autopilot: the coordinator-side policy engine "
+         "over the fleet snapshot stream.  `off` (default) never "
+         "constructs the engine — coordinator behavior and the compiled "
+         "step are exactly the pre-autopilot ones; `observe` runs the full "
+         "decision matrix and flight-records every decision WITHOUT "
+         "actuating (the dry-run rollout mode); `act` additionally "
+         "actuates through the existing machinery (health fence/resize, "
+         "autotune perf hints, algorithm-family switch, checkpoint "
+         "storage quarantine).",
+         choices=("off", "observe", "act"))
+_declare("BAGUA_AUTOPILOT_SLO_GOODPUT", "float", "0",
+         "Goodput-fraction SLO for the autopilot's escalation ladder: a "
+         "fleet whose worst rank sits below this fraction for "
+         "BAGUA_AUTOPILOT_SUSTAIN consecutive snapshots walks hint -> "
+         "retune -> algorithm-family switch -> resize.  0 (default) "
+         "disables the SLO rule.")
+_declare("BAGUA_AUTOPILOT_SUSTAIN", "int", "3",
+         "Hysteresis: consecutive fleet snapshots a rule's condition must "
+         "hold before its action fires (one blip never actuates).")
+_declare("BAGUA_AUTOPILOT_COOLDOWN_S", "float", "300",
+         "Per-action-kind cooldown: after an autopilot action of a kind "
+         "fires, further actions of that kind are suppressed for this "
+         "many seconds (counted in autopilot/suppressed_cooldown).")
+_declare("BAGUA_AUTOPILOT_BUDGET", "int", "8",
+         "Global action budget per run: once the autopilot has taken this "
+         "many actions it stops actuating entirely (counted in "
+         "autopilot/suppressed_budget) — a mis-tuned policy can never "
+         "flap a fleet indefinitely.  0 disables the autopilot's actions.")
+_declare("BAGUA_AUTOPILOT_STALENESS_S", "float", "60",
+         "Fleet-snapshot freshness bound: the policy engine refuses to "
+         "decide on a snapshot older than this (a wedged snapshot writer "
+         "must not cause actions from stale evidence; counted in "
+         "autopilot/stale_snapshots).")
+_declare("BAGUA_AUTOPILOT_STRAGGLER_RATIO", "float", "3.0",
+         "Minimum straggler_suspect step-time ratio for the autopilot's "
+         "chronic-straggler / victim rules to count a snapshot toward "
+         "their sustain streak (blips below it are the anomaly "
+         "detector's business, not the autopilot's).")
+_declare("BAGUA_AUTOPILOT_SUSPECT_TTL_S", "float", "120",
+         "How long a straggler_suspect stays live evidence: a suspect "
+         "detected longer ago than this no longer feeds the straggler/"
+         "victim streaks (the beacon keeps re-publishing the LATEST "
+         "suspect even after the rank recovers).")
+_declare("BAGUA_AUTOPILOT_CKPT_FAILURES", "int", "3",
+         "Checkpoint-integrity threshold: a rank reporting at least this "
+         "many integrity failures + fallback restores gets its storage "
+         "path quarantined (saves redirect; see docs/autopilot.md).")
+_declare("BAGUA_AUTOPILOT_FAMILY", "str", "async",
+         "Algorithm family the escalation ladder's switch rung commands "
+         "(through the autotune service's recommendation path; must be a "
+         "SWITCHABLE_ALGORITHMS name).")
+_declare("BAGUA_AUTOPILOT_MODEL", "str", "bagua_module",
+         "Autotune task (model_name) the autopilot's perf hints and "
+         "family-switch commands address — the BaguaTrainer model_name "
+         "default unless the job names its model.")
+_declare("BAGUA_CKPT_QUARANTINED_PATHS", "str", "",
+         "Newline-separated checkpoint directories under storage "
+         "quarantine (newline, not os.pathsep — ':' appears inside "
+         "gs://-style URIs): BaguaCheckpointManager redirects saves for "
+         "them to a `<dir>.redirect` sibling while restores keep walking "
+         "the verified pre-quarantine history.  Injected by the elastic "
+         "launcher at restart boundaries when the autopilot (in act mode) "
+         "quarantined a path; operators can set it by hand.")
 
 
 # ---- typed accessors -----------------------------------------------------
@@ -645,6 +710,72 @@ def get_serve_prefill_chunk() -> int:
 def get_serve_tick_idle_s() -> float:
     """Scheduler idle-poll granularity in seconds."""
     return env_float("BAGUA_SERVE_TICK_IDLE_S")
+
+
+def get_autopilot_mode() -> str:
+    """Fleet-autopilot mode: ``off`` (default — no engine), ``observe``
+    (decide + flight-record, never actuate), or ``act``."""
+    return env_enum("BAGUA_AUTOPILOT")
+
+
+def get_autopilot_slo_goodput() -> float:
+    """Goodput-fraction SLO for the escalation ladder (0 = rule off)."""
+    return env_float("BAGUA_AUTOPILOT_SLO_GOODPUT")
+
+
+def get_autopilot_sustain() -> int:
+    """Consecutive snapshots a rule must hold before acting."""
+    return env_int("BAGUA_AUTOPILOT_SUSTAIN")
+
+
+def get_autopilot_cooldown_s() -> float:
+    """Per-action-kind cooldown in seconds."""
+    return env_float("BAGUA_AUTOPILOT_COOLDOWN_S")
+
+
+def get_autopilot_budget() -> int:
+    """Global autopilot action budget per run."""
+    return env_int("BAGUA_AUTOPILOT_BUDGET")
+
+
+def get_autopilot_staleness_s() -> float:
+    """Fleet-snapshot freshness bound in seconds."""
+    return env_float("BAGUA_AUTOPILOT_STALENESS_S")
+
+
+def get_autopilot_straggler_ratio() -> float:
+    """Minimum suspect ratio feeding the straggler/victim streaks."""
+    return env_float("BAGUA_AUTOPILOT_STRAGGLER_RATIO")
+
+
+def get_autopilot_suspect_ttl_s() -> float:
+    """Straggler-suspect evidence time-to-live in seconds."""
+    return env_float("BAGUA_AUTOPILOT_SUSPECT_TTL_S")
+
+
+def get_autopilot_ckpt_failures() -> int:
+    """Checkpoint-integrity event threshold for storage quarantine."""
+    return env_int("BAGUA_AUTOPILOT_CKPT_FAILURES")
+
+
+def get_autopilot_family() -> str:
+    """Algorithm family the ladder's switch rung commands."""
+    return env_str("BAGUA_AUTOPILOT_FAMILY")
+
+
+def get_autopilot_model() -> str:
+    """Autotune task (model_name) autopilot hints address."""
+    return env_str("BAGUA_AUTOPILOT_MODEL")
+
+
+def get_ckpt_quarantined_paths() -> list:
+    """Checkpoint directories under storage quarantine (possibly []).
+    Newline-separated: ``os.pathsep`` is ``:`` on POSIX and would split
+    ``gs://``-style URI directories apart."""
+    raw = _raw("BAGUA_CKPT_QUARANTINED_PATHS")
+    if not raw:
+        return []
+    return [p.strip() for p in raw.splitlines() if p.strip()]
 
 
 def get_elastic_store_addr() -> Optional[str]:

@@ -74,8 +74,9 @@ __all__ = [
     "note_step", "note_step_metrics", "note_anomaly",
     "note_device_attribution", "last_device_attribution",
     "note_mfu", "last_mfu", "note_hbm_footprint", "last_hbm_footprint",
-    "note_hbm_live", "last_hbm_live",
-    "write_fleet_snapshot", "validate_fleet_snapshot", "FLEET_SCHEMA",
+    "note_hbm_live", "last_hbm_live", "note_ckpt_directory",
+    "build_fleet_record", "write_fleet_snapshot", "validate_fleet_snapshot",
+    "FLEET_SCHEMA",
 ]
 
 
@@ -238,6 +239,51 @@ _declare("obs/hbm_headroom_bytes", "gauge",
          "capacity-planning margin (real TPU only).")
 
 
+# -- fleet autopilot (docs/autopilot.md) --
+_declare("autopilot/snapshots", "counter",
+         "Fleet snapshots the autopilot's policy engine evaluated.")
+_declare("autopilot/stale_snapshots", "counter",
+         "Fleet snapshots the policy engine REFUSED to decide on because "
+         "they were older than BAGUA_AUTOPILOT_STALENESS_S — a wedged "
+         "snapshot writer must not cause actions from stale evidence.")
+_declare("autopilot/decisions", "counter",
+         "Actions the pure decision core emitted (observe AND act mode — "
+         "a decision is counted whether or not it actuates).")
+_declare("autopilot/actions_actuated", "counter",
+         "Decided actions actually actuated (act mode only).")
+_declare("autopilot/observed_only", "counter",
+         "Decided actions logged without actuation (observe mode — the "
+         "dry-run rollout counter).")
+_declare("autopilot/suppressed_cooldown", "counter",
+         "Rule firings suppressed because their action kind was inside "
+         "its cooldown window.")
+_declare("autopilot/suppressed_budget", "counter",
+         "Rule firings suppressed because the global action budget "
+         "(BAGUA_AUTOPILOT_BUDGET) was exhausted.")
+_declare("autopilot/fences", "counter",
+         "Chronic-straggler fence decisions (rank health-fenced, world "
+         "resized down through the elastic epoch machinery).")
+_declare("autopilot/retunes", "counter",
+         "Retune decisions (collective-dominant victims and the ladder's "
+         "hint/retune rungs) delivered as autotune perf hints with "
+         "service-side re-measure.")
+_declare("autopilot/family_switches", "counter",
+         "Escalation-ladder algorithm-family-switch decisions (commanded "
+         "through the autotune recommendation path; the trainers' switch "
+         "is a re-jit, not a restart).")
+_declare("autopilot/resizes", "counter",
+         "Escalation-ladder terminal resize decisions (worst-goodput "
+         "node removed through the fence/epoch machinery).")
+_declare("autopilot/quarantines", "counter",
+         "Checkpoint storage paths quarantined after repeated integrity "
+         "failures/fallback restores (saves redirect).")
+_declare("autopilot/escalation_rung", "gauge",
+         "Current SLO-escalation ladder rung (0 = healthy, 1 hint, "
+         "2 retune, 3 family switch, 4 resize).")
+_declare("autopilot/state_persists", "counter",
+         "Policy-state snapshots persisted to the restart store (the "
+         "coordinator-restart idempotence channel: cooldowns, rung, "
+         "quarantined paths survive a relaunch).")
 # -- serving plane (docs/serving.md) --
 _declare("serve/requests_admitted", "counter",
          "Requests admitted from the queue into an engine batch slot "
@@ -337,6 +383,7 @@ _LAST_DEVICE_ATTRIBUTION: Optional[Dict[str, Any]] = None
 _LAST_MFU: Optional[Dict[str, Any]] = None
 _LAST_HBM_FOOTPRINT: Optional[Dict[str, Any]] = None
 _LAST_HBM_LIVE: Optional[Dict[str, Any]] = None
+_LAST_CKPT_DIRECTORY: Optional[str] = None
 
 
 def note_step(step: int, step_dt: Optional[float]) -> None:
@@ -459,6 +506,15 @@ def last_hbm_live() -> Optional[Dict[str, Any]]:
         return dict(_LAST_HBM_LIVE) if _LAST_HBM_LIVE is not None else None
 
 
+def note_ckpt_directory(directory: str) -> None:
+    """Checkpoint-manager hook: the storage path this rank saves to rides
+    the obs summary, so the coordinator-side autopilot can name WHICH path
+    to quarantine when the rank's integrity counters climb."""
+    global _LAST_CKPT_DIRECTORY
+    with _SUMMARY_LOCK:
+        _LAST_CKPT_DIRECTORY = str(directory)
+
+
 def _percentile(sorted_vals: List[float], q: float) -> float:
     idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
     return sorted_vals[idx]
@@ -478,6 +534,7 @@ def local_obs_summary() -> Optional[dict]:
         mfu = dict(_LAST_MFU) if _LAST_MFU else None
         footprint = dict(_LAST_HBM_FOOTPRINT) if _LAST_HBM_FOOTPRINT else None
         hbm_live = dict(_LAST_HBM_LIVE) if _LAST_HBM_LIVE else None
+        ckpt_dir = _LAST_CKPT_DIRECTORY
     if step is None:
         return None
     summary = {
@@ -486,6 +543,18 @@ def local_obs_summary() -> Optional[dict]:
         "staleness": counters.get("async/staleness_max"),
         "skipped_steps": counters.get("grad_guard/skipped_steps"),
     }
+    # checkpoint-integrity evidence for the autopilot's quarantine rule:
+    # how often this rank's restores failed verification / fell back, and
+    # which storage path its manager writes (None of it costs bytes while
+    # the chain is clean and no manager exists)
+    ckpt_failures = counters.get("ckpt/integrity_failures")
+    ckpt_fallbacks = counters.get("ckpt/fallback_restores")
+    if ckpt_failures:
+        summary["ckpt_integrity_failures"] = ckpt_failures
+    if ckpt_fallbacks:
+        summary["ckpt_fallback_restores"] = ckpt_fallbacks
+    if ckpt_dir and (ckpt_failures or ckpt_fallbacks):
+        summary["ckpt_directory"] = ckpt_dir
     if dts:
         summary["step_dt_p50"] = round(_percentile(dts, 0.5), 6)
         summary["step_dt_p90"] = round(_percentile(dts, 0.9), 6)
@@ -547,6 +616,7 @@ def reset_local_summary() -> None:
     """Forget the per-rank summary (test isolation)."""
     global _LAST_STEP, _LAST_ANOMALY, _LAST_DEVICE_ATTRIBUTION
     global _LAST_MFU, _LAST_HBM_FOOTPRINT, _LAST_HBM_LIVE
+    global _LAST_CKPT_DIRECTORY
     with _SUMMARY_LOCK:
         _LAST_STEP = None
         _STEP_DTS.clear()
@@ -556,6 +626,7 @@ def reset_local_summary() -> None:
         _LAST_MFU = None
         _LAST_HBM_FOOTPRINT = None
         _LAST_HBM_LIVE = None
+        _LAST_CKPT_DIRECTORY = None
 
 
 # ---- Prometheus / JSONL rendering -----------------------------------------
@@ -761,37 +832,48 @@ def _fleet_efficiency(ranks: Dict[str, dict]) -> dict:
     return out
 
 
-def write_fleet_snapshot(path: str, epoch: int,
-                         members: Dict[int, Optional[dict]]) -> bool:
-    """Coordinator-side fleet view: merge every member's latest heartbeat
-    health payload (``LeaseTracker.health_of``) into one atomic JSON
-    snapshot — per node: the fence-relevant health events plus the per-rank
-    ``obs`` summaries its launcher merged from the workers' beacons.
-    Exception-free (the caller is the launcher's monitor loop)."""
-    try:
-        ranks: Dict[str, dict] = {}
-        for node_id, payload in members.items():
-            payload = payload or {}
-            obs = payload.get("obs") or {}
-            if "step" in obs:
-                # a single-rank summary (the in-process heartbeat default
-                # source) normalizes to the launcher's per-rank shape
-                obs = {str(obs.get("rank", 0)): obs}
-            ranks[str(int(node_id))] = {
-                "health": {k: v for k, v in payload.items() if k != "obs"},
-                "obs": obs,
-            }
-        record = {
-            "schema": FLEET_SCHEMA,
-            "time_unix": time.time(),
-            "epoch": int(epoch),
-            "nnodes": len(members),
-            "ranks": ranks,
-            # efficiency rollup: aggregate goodput + each rank's worst
-            # badput class, lifted from the per-rank summaries above — the
-            # fleet-level answer to "where is the fleet's wall-clock going"
-            "efficiency": _fleet_efficiency(ranks),
+def build_fleet_record(epoch: int,
+                       members: Dict[int, Optional[dict]]) -> dict:
+    """Merge every member's latest heartbeat health payload
+    (``LeaseTracker.health_of``) into one ``bagua-obs-fleet-v1`` record —
+    per node: the fence-relevant health events plus the per-rank ``obs``
+    summaries its launcher merged from the workers' beacons.  The ONE
+    merge both the snapshot file and the autopilot's policy engine
+    consume."""
+    ranks: Dict[str, dict] = {}
+    for node_id, payload in members.items():
+        payload = payload or {}
+        obs = payload.get("obs") or {}
+        if "step" in obs:
+            # a single-rank summary (the in-process heartbeat default
+            # source) normalizes to the launcher's per-rank shape
+            obs = {str(obs.get("rank", 0)): obs}
+        ranks[str(int(node_id))] = {
+            "health": {k: v for k, v in payload.items() if k != "obs"},
+            "obs": obs,
         }
+    return {
+        "schema": FLEET_SCHEMA,
+        "time_unix": time.time(),
+        "epoch": int(epoch),
+        "nnodes": len(members),
+        "ranks": ranks,
+        # efficiency rollup: aggregate goodput + each rank's worst
+        # badput class, lifted from the per-rank summaries above — the
+        # fleet-level answer to "where is the fleet's wall-clock going"
+        "efficiency": _fleet_efficiency(ranks),
+    }
+
+
+def write_fleet_snapshot(path: str, epoch: int,
+                         members: Optional[Dict[int, Optional[dict]]] = None,
+                         record: Optional[dict] = None) -> bool:
+    """Write the coordinator-side fleet snapshot atomically — from
+    ``members`` (merged here) or a pre-built ``record``.  Exception-free
+    (the caller is the launcher's monitor loop)."""
+    try:
+        if record is None:
+            record = build_fleet_record(epoch, members or {})
         _atomic_write(str(path), json.dumps(record, indent=1, sort_keys=True))
         return True
     except OSError as e:

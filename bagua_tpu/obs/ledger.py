@@ -127,6 +127,9 @@ DRILL_BADPUT_EXPECTATIONS = {
     "nan_grad_skip_loss_continuity": "rewind",
     "async_partition_staleness_catchup": "catchup_sync",
     "checkpoint_corruption_fallback_restore": "checkpoint",
+    # the autopilot's quarantine drill walks a real fallback restore (3
+    # torn steps) before the engine acts — that walk is checkpoint badput
+    "autopilot_ckpt_quarantine": "checkpoint",
 }
 
 # Peak per-chip silicon specs for MFU / roofline reporting, keyed by

@@ -53,7 +53,8 @@ FLIGHT_SCHEMA = "bagua-obs-flight-v1"
 #: unknown triggers still dump — a new defense path must not lose its
 #: artifact to an enum)
 KNOWN_TRIGGERS = ("watchdog_abort", "grad_guard_abort", "health_fence",
-                  "fault_fire", "signal", "step_anomaly")
+                  "fault_fire", "signal", "step_anomaly",
+                  "autopilot_action")
 
 _SAFE = re.compile(r"[^A-Za-z0-9_.-]")
 _DUMP_LOCK = threading.Lock()

@@ -64,6 +64,12 @@ class _TaskState:
         self.perf_hints_total = 0
         self.sample_hint_mark = 0
         self.sample_retried = False
+        # fleet-autopilot controller state (docs/autopilot.md): a pinned
+        # algorithm family overrides every recommendation until cleared
+        # (the ladder's switch rung must survive later BO points), and
+        # extra_samples re-opens a completed search for a bounded retune
+        self.pinned_algorithm: Optional[str] = None
+        self.extra_samples = 0
         # per-round decision cache: every rank asking at the same train_iter
         # must receive the SAME recommendation, or the ranks' compiled SPMD
         # programs diverge and their collectives deadlock (trainers check in
@@ -122,16 +128,58 @@ class AutotuneService:
 
     def report_metrics(self, req: dict) -> dict:
         task = self._task(req["model_name"])
+        rank = int(req["rank"])
         with task.lock:
-            task.speed_by_rank[int(req["rank"])] = float(req["speed"])
+            if rank >= 0:
+                task.speed_by_rank[rank] = float(req["speed"])
+            # a NEGATIVE rank is a controller (the fleet autopilot, rank
+            # -1): its report carries hints only — recording its zero
+            # "speed" would poison the ranks' summed score
             for hint in req.get("perf_hints") or []:
                 if isinstance(hint, dict):
-                    task.perf_hints.append(
-                        {**hint, "reported_by": int(req["rank"])}
-                    )
+                    task.perf_hints.append({**hint, "reported_by": rank})
                     task.perf_hints_total += 1
+                    self._apply_controller_hint(task, hint)
             del task.perf_hints[:-64]  # bounded: hints are context, not log
         return {"message": "ok"}
+
+    def _apply_controller_hint(self, task: _TaskState, hint: dict) -> None:
+        """Fleet-autopilot command hints (caller holds ``task.lock``).
+        Ordinary hints (``autopilot_retune_hint``, the anomaly detector's
+        ``step_time_anomaly``) need nothing here — arriving inside a
+        sampling window already makes the state machine re-measure it.
+
+        * ``autopilot_retune`` — a COMMANDED retune outranks the
+          once-per-point re-measure budget (``sample_retried`` resets),
+          and re-opens a completed search for a bounded number of extra
+          samples: the escalation ladder's "retune" rung must still mean
+          something after the BO loop pinned its best point.
+        * ``autopilot_switch_family`` — pin the recommended algorithm
+          family; every rank applies it at its next check-in through the
+          NORMAL recommendation path (``_maybe_switch_algorithm`` — a
+          re-jit plus a queued state migration, never a restart), and the
+          per-train_iter decision cache keeps the switch SPMD-uniform.
+        """
+        kind = hint.get("kind")
+        if kind == "autopilot_retune":
+            task.sample_retried = False
+            if task.completed and task.extra_samples < 16:
+                task.extra_samples += 4
+                task.completed = False
+                logger.info(
+                    "autotune[%s]: autopilot retune re-opened the search "
+                    "(+4 samples, %d extra total)", task.model_name,
+                    task.extra_samples,
+                )
+        elif kind == "autopilot_switch_family":
+            family = hint.get("family")
+            if family:
+                task.pinned_algorithm = str(family)
+                task.recommended.algorithm = str(family)
+                logger.info(
+                    "autotune[%s]: autopilot pinned algorithm family %r",
+                    task.model_name, family,
+                )
 
     def report_tensor_execution_order(self, req: dict) -> dict:
         spans = req.get("spans", [])
@@ -215,7 +263,7 @@ class AutotuneService:
             train_iter, task.tensor_list, task.recommended, score
         )
         task.n_samples += 1
-        if task.n_samples >= self.max_samples:
+        if task.n_samples >= self.max_samples + task.extra_samples:
             best = task.manager.best_hyperparameters(task.tensor_list)
             task.recommended = best if best is not None else task.recommended
             task.completed = True
@@ -236,6 +284,10 @@ class AutotuneService:
         return self._reply(task)
 
     def _reply(self, task: _TaskState) -> dict:
+        if task.pinned_algorithm:
+            # the autopilot's pin survives BO points and completion: every
+            # reply carries it until a new pin replaces it
+            task.recommended.algorithm = task.pinned_algorithm
         return {
             "recommended_hyperparameters": task.recommended.model_dump(),
             "is_autotune_completed": task.completed,

@@ -143,6 +143,15 @@ FLIGHT_EXPECTATIONS = {
                                       "trigger": "step_anomaly"},
     "async_partition_staleness_catchup": {"fault_point": "async.partition"},
     "health_fence_flight_record": {"trigger": "health_fence"},
+    # fleet autopilot (docs/autopilot.md): every decided action leaves an
+    # `autopilot_action` flight dump with its triggering evidence
+    "autopilot_straggler_fence_resize": {"fault_point": "step.straggle",
+                                         "trigger": "autopilot_action"},
+    "autopilot_victim_retune_hint": {"fault_point": "step.straggle",
+                                     "trigger": "autopilot_action"},
+    "autopilot_slo_escalation_ladder": {"trigger": "autopilot_action"},
+    "autopilot_ckpt_quarantine": {"fault_point": "ckpt.write",
+                                  "trigger": "autopilot_action"},
 }
 
 
@@ -830,6 +839,465 @@ def drill_health_fence(tmp):
                        f"per-rank obs summaries (valid: {fleet_ok})"}
 
 
+# ---- fleet autopilot drills (docs/autopilot.md) ---------------------------
+#
+# The policy matrix end-to-end, each rule injected -> detected -> DECIDED ->
+# ACTUATED -> recovered: the autopilot consumes fleet snapshots built by the
+# production merge (beacons -> merged_health_source -> build_fleet_record),
+# decides through the pure core, and actuates through the pre-existing
+# machinery only — the health-fence stop event, AutotuneClient perf hints
+# with service-side consumption, the autotune recommendation path for the
+# family switch (the trainer's switch is a re-jit + a queued state
+# migration), and the checkpoint storage-quarantine registry.
+
+
+def _autopilot_engine(mode="act", actuators=None, **cfg):
+    from bagua_tpu.autopilot import AutopilotEngine, PolicyConfig
+
+    base = dict(mode=mode, sustain=2, cooldown_s=0.0, budget=8,
+                staleness_s=60.0, slo_goodput=0.0, straggler_ratio=3.0,
+                suspect_ttl_s=600.0, ckpt_failures=3, switch_family="async")
+    base.update(cfg)
+    return AutopilotEngine(config=PolicyConfig(**base), actuators=actuators)
+
+
+def _fleet_record_from_beacon(beacon_path, node_id=1):
+    """The production coordinator merge over one worker beacon: node 0 is
+    the (payload-less) coordinator, ``node_id`` the reporting worker."""
+    from bagua_tpu.elastic import membership as mb
+    from bagua_tpu.obs.export import build_fleet_record
+
+    payload = mb.merged_health_source([beacon_path])()
+    return build_fleet_record(0, {0: None, node_id: payload})
+
+
+def _relabel_beacon_rank(beacon_path, rank):
+    """Both anomaly legs run in THIS process (env rank 0); relabeling the
+    beacon's rank is the only hand-made part of the fleet path (same
+    convention as the straggler drill)."""
+    rec = json.load(open(beacon_path))
+    rec["obs"]["rank"] = rank
+    if "straggler_suspect" in rec["obs"]:
+        rec["obs"]["straggler_suspect"]["rank"] = rank
+    with open(beacon_path, "w") as f:
+        json.dump(rec, f)
+
+
+def _actuate_autopilot_stop(action):
+    """The monitor loop's fence/resize half on a live membership client:
+    ``publish_autopilot_stop`` (the production publisher) converts the
+    action into the ``health_fenced`` stop event the epoch/resize
+    machinery rides; returns (stop_event, survivor_set) for a 2-node
+    world."""
+    from bagua_tpu.contrib.utils.store import InMemoryStore
+    from bagua_tpu.distributed.run import publish_autopilot_stop
+    from bagua_tpu.elastic import membership as mb
+
+    client = mb.MembershipClient(InMemoryStore(), node_id=0, max_nnodes=2)
+    nodes = [int(n) for n in action.target]
+    publish_autopilot_stop(client, 0, action, nodes)
+    stop = client.read_stop(0)
+    survivors = {0, 1} - set(stop["nodes"]) if stop else {0, 1}
+    return stop, survivors
+
+
+def drill_autopilot_straggler_fence(tmp):
+    """Chronic dispatch-dominant straggler -> autopilot fence + resize:
+    a REAL self-straggled trainer run flags dispatch-dominant suspects
+    (the production detector), the beacon rides the production merge into
+    a fleet snapshot, the policy engine sustains the evidence over two
+    snapshots and decides the fence, and the action actuates through the
+    same ``health_fenced`` stop event lease expiry rides — the world
+    resizes down to the survivors."""
+    from bagua_tpu import telemetry as _t
+    from bagua_tpu.elastic import membership as mb
+
+    anomaly_env = {"BAGUA_OBS_ANOMALY_WARMUP": "4",
+                   "BAGUA_OBS_ANOMALY_WINDOW": "24"}
+    saved = {k: os.environ.get(k) for k in anomaly_env}
+    os.environ.update(anomaly_env)
+    before = telemetry.counters.snapshot()
+    try:
+        # self-straggle on the async family: local slowness files under
+        # `dispatch` — the straggler's own signature
+        suspects, beacon = _anomaly_leg(0, 1, 10.0, 10.0, tmp)
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else os.environ.update(
+                {k: v})
+    deltas = _counter_deltas(before)
+    detected = (
+        bool(suspects)
+        and suspects[-1]["dominant_phase"] == "dispatch"
+        and deltas.get("faults/step.straggle/fired", 0) >= 1
+    )
+    _relabel_beacon_rank(beacon, 1)
+
+    engine = _autopilot_engine(sustain=2)
+    actions = []
+    for _ in range(2):
+        time.sleep(0.02)  # distinct snapshot time_unix per poll
+        actions = engine.observe_snapshot(_fleet_record_from_beacon(beacon))
+    decided = (
+        len(actions) == 1 and actions[0].kind == "fence"
+        and actions[0].rule == "chronic_straggler"
+        and actions[0].target == [1]
+    )
+    stop, survivors = (None, None)
+    if decided:
+        stop, survivors = _actuate_autopilot_stop(actions[0])
+        engine.note_actuated(actions[0])
+        if detected:
+            inject.record_recovery("step.straggle")
+    actuated = bool(
+        stop and stop["kind"] == mb.STOP_HEALTH and stop["nodes"] == [1]
+        and stop["rejoin"] is False
+    )
+    return {"injected": True,
+            "detected": bool(detected and decided),
+            "recovered": bool(actuated and survivors == {0}),
+            "decided_actions": [a.kind for a in actions],
+            "details": f"dispatch-dominant suspect (ratio "
+                       f"{suspects[-1]['ratio'] if suspects else None}) "
+                       f"sustained 2 snapshots -> fence node 1; stop "
+                       f"{stop and stop['kind']} rejoin={stop and stop['rejoin']}; "
+                       f"world resizes to {sorted(survivors or [])}"}
+
+
+def drill_autopilot_victim_retune(tmp):
+    """Collective-dominant victim -> retune hint CONSUMED: the gated-peer
+    leg flags a collective-dominant suspect, the engine decides a retune
+    hint and delivers it through ``AutotuneClient.report_metrics`` as the
+    controller rank, and the live autotune service provably consumes it —
+    the hinted sampling window is RE-MEASURED instead of scored."""
+    import threading
+
+    from bagua_tpu.autopilot import default_engine_actuators
+    from bagua_tpu.service.autotune_service import (
+        AutotuneService,
+        make_server,
+    )
+
+    anomaly_env = {"BAGUA_OBS_ANOMALY_WARMUP": "4",
+                   "BAGUA_OBS_ANOMALY_WINDOW": "24"}
+    saved = {k: os.environ.get(k) for k in anomaly_env}
+    os.environ.update(anomaly_env)
+    before = telemetry.counters.snapshot()
+    try:
+        # peer-of-rank-1 straggle on the async family: the WAIT files
+        # under `collective` — the victim's signature
+        suspects, beacon = _anomaly_leg(1, 0, 10.0, 10.0, tmp)
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else os.environ.update(
+                {k: v})
+    deltas = _counter_deltas(before)
+    detected = (
+        bool(suspects)
+        and suspects[-1]["dominant_phase"] == "collective"
+        and deltas.get("faults/step.straggle/fired", 0) >= 1
+    )
+
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=10,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0,
+    )
+    server = make_server(0, service)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        model = "autopilot_victim_drill"
+        # open a sampling window: one scored sample, window restarts
+        service.report_metrics({"model_name": model, "rank": 0,
+                                "train_iter": 1, "hyperparameters": {},
+                                "speed": 100.0})
+        service.ask_hyperparameters({"model_name": model, "rank": 0,
+                                     "train_iter": 1})
+        task = service._task(model)
+        samples_before = task.n_samples
+
+        engine = _autopilot_engine(
+            sustain=2,
+            actuators=default_engine_actuators(
+                model_name=model, autotune_addr=f"127.0.0.1:{port}"),
+        )
+        actions = []
+        for _ in range(2):
+            time.sleep(0.02)
+            actions = engine.observe_snapshot(
+                _fleet_record_from_beacon(beacon))
+        decided = (
+            len(actions) == 1 and actions[0].kind == "retune_hint"
+            and actions[0].rule == "collective_victim"
+        )
+        with task.lock:
+            delivered = task.perf_hints_total >= 1 and any(
+                h.get("kind") == "autopilot_retune_hint"
+                and h.get("reported_by") == -1 for h in task.perf_hints
+            )
+        # the service CONSUMES the hint: the next confidence-gated
+        # decision re-measures the window instead of scoring it
+        service.report_metrics({"model_name": model, "rank": 0,
+                                "train_iter": 2, "hyperparameters": {},
+                                "speed": 100.0})
+        service.ask_hyperparameters({"model_name": model, "rank": 0,
+                                     "train_iter": 2})
+        consumed = (task.n_samples == samples_before
+                    and task.sample_retried is True)
+        if detected and decided and consumed:
+            inject.record_recovery("step.straggle")
+    finally:
+        server.shutdown()
+    return {"injected": True,
+            "detected": bool(detected and decided),
+            "recovered": bool(delivered and consumed),
+            "decided_actions": [a.kind for a in actions],
+            "details": f"collective-dominant victim sustained 2 snapshots "
+                       f"-> retune hint; delivered as controller rank -1: "
+                       f"{delivered}; service re-measured the hinted "
+                       f"window (n_samples {samples_before} unchanged, "
+                       f"retry armed): {consumed}"}
+
+
+def drill_autopilot_slo_ladder(tmp):
+    """Sustained goodput-SLO breach -> the escalation ladder walked IN
+    ORDER (hint -> retune -> family switch -> resize), with the switch
+    actuated END-TO-END: the engine pins the family through the autotune
+    service's recommendation path, and a LIVE autotuned trainer applies it
+    at its next check-in — a re-jit plus the queued replicated->stacked
+    state migration, never a restart.  The terminal resize actuates
+    through the same stop event the fence rides."""
+    import threading
+
+    import optax
+
+    import bench
+    from bagua_tpu.algorithms import GradientAllReduceAlgorithm
+    from bagua_tpu.autopilot import LADDER, default_engine_actuators
+    from bagua_tpu.communication import get_hyperparameters_service_client
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.obs.export import build_fleet_record
+    from bagua_tpu.parallel.mesh import build_mesh
+    from bagua_tpu.service.autotune_service import (
+        AutotuneService,
+        make_server,
+    )
+
+    model = "autopilot_ladder_drill"
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=50,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0,
+    )
+    server = make_server(0, service)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    env_save = {k: os.environ.get(k) for k in
+                ("BAGUA_SERVICE_PORT", "MASTER_ADDR", "BAGUA_AUTOTUNE")}
+    os.environ.update(BAGUA_SERVICE_PORT=str(port),
+                      MASTER_ADDR="127.0.0.1", BAGUA_AUTOTUNE="1")
+    get_hyperparameters_service_client.cache_clear()
+    try:
+        loss_fn, params, batch = bench.golden_task()
+        trainer = BaguaTrainer(
+            loss_fn, optax.sgd(0.1), GradientAllReduceAlgorithm(),
+            mesh=build_mesh({"dp": 8}), model_name=model,
+            flat_resident="off",
+        )
+        state = trainer.init(params)
+        b = trainer.shard_batch(batch)
+        for _ in range(100):  # past the first check-in (step 100)
+            state, loss = trainer.train_step(state, b)
+
+        # the injected degradation: a fleet whose worst rank sits far
+        # below the goodput SLO, sustained — each poll re-merges a fresh
+        # snapshot the way the coordinator writer does
+        engine = _autopilot_engine(
+            sustain=1, slo_goodput=0.5, switch_family="async",
+            actuators=default_engine_actuators(
+                model_name=model, autotune_addr=f"127.0.0.1:{port}"),
+        )
+        fired = []
+        for _ in range(len(LADDER)):
+            time.sleep(0.02)
+            record = build_fleet_record(0, {0: None, 1: {"obs": {
+                "1": {"rank": 1, "step": 100, "goodput_fraction": 0.12},
+            }}})
+            fired.extend(engine.observe_snapshot(record))
+        ladder_order = [a.kind for a in fired]
+        decided = ladder_order == list(LADDER)
+        task = service._task(model)
+        with task.lock:
+            pinned = task.pinned_algorithm == "async"
+
+        # the switch lands at the trainer's next check-in, then the queued
+        # replication migration converts the live state before the
+        # re-jitted stacked step consumes it
+        for _ in range(110):
+            state, loss = trainer.train_step(state, b)
+        switched = type(trainer.algorithm).__name__ == \
+            "AsyncModelAverageAlgorithm"
+        stacked = jax.tree.leaves(state.params)[0].shape[0] == 8
+        if switched and hasattr(trainer.algorithm, "barrier"):
+            state = trainer.algorithm.barrier(trainer, state)
+        finite = bool(np.isfinite(float(loss)))
+
+        stop, survivors = (None, None)
+        resize = [a for a in fired if a.kind == "resize"]
+        if resize:
+            stop, survivors = _actuate_autopilot_stop(resize[0])
+            engine.note_actuated(resize[0])
+        actuated_resize = bool(stop and stop["rejoin"] is False
+                               and stop["nodes"] == [1])
+    finally:
+        for k, v in env_save.items():
+            os.environ.pop(k, None) if v is None else os.environ.update(
+                {k: v})
+        get_hyperparameters_service_client.cache_clear()
+        server.shutdown()
+    return {"injected": True,
+            "detected": bool(decided and pinned),
+            "recovered": bool(switched and stacked and finite
+                              and actuated_resize),
+            "ladder_order": ladder_order,
+            "details": f"ladder walked {ladder_order} (in order: {decided}); "
+                       f"service pinned family async: {pinned}; trainer "
+                       f"switched via re-jit+migration: {switched} "
+                       f"(stacked: {stacked}, finite loss: {finite}); "
+                       f"terminal resize stop published: {actuated_resize}"}
+
+
+def drill_autopilot_off_noop():
+    """BAGUA_AUTOPILOT=off (the default) changes NOTHING: the launcher's
+    engine-construction gate stays closed (run_elastic builds no engine —
+    the coordinator monitor path is the pre-autopilot one), no
+    ``autopilot/*`` counter moves, and the compiled train step is
+    jaxpr-IDENTICAL across off/observe/act — the autopilot is
+    coordinator-side by construction and never reaches the traced
+    program."""
+    from bagua_tpu import env as _env
+    from bagua_tpu.algorithms import GradientAllReduceAlgorithm
+
+    saved = os.environ.get("BAGUA_AUTOPILOT")
+    os.environ.pop("BAGUA_AUTOPILOT", None)
+    before = telemetry.counters.snapshot()
+    try:
+        default_off = _env.get_autopilot_mode() == "off"
+        # run_elastic's gate, verbatim: mode off -> no engine exists
+        engine_gate_closed = not (_env.get_autopilot_mode() != "off")
+        t, s, b = _golden_trainer(GradientAllReduceAlgorithm())
+        jaxprs = {}
+        for mode in ("off", "observe", "act"):
+            os.environ["BAGUA_AUTOPILOT"] = mode
+            jaxprs[mode] = str(t.trace_step(s, b))
+    finally:
+        os.environ.pop("BAGUA_AUTOPILOT", None)
+        if saved is not None:
+            os.environ["BAGUA_AUTOPILOT"] = saved
+    deltas = _counter_deltas(before)
+    no_autopilot_counters = not any(
+        k.startswith("autopilot/") for k in deltas)
+    pinned = jaxprs["off"] == jaxprs["observe"] == jaxprs["act"]
+    return {"injected": True,  # the mode flip itself is the intervention
+            "detected": bool(pinned),
+            "recovered": bool(default_off and engine_gate_closed
+                              and no_autopilot_counters),
+            "jaxpr_identical": bool(pinned),
+            "details": f"default mode off: {default_off}; engine gate "
+                       f"closed: {engine_gate_closed}; step jaxpr "
+                       f"identical across off/observe/act: {pinned}; no "
+                       f"autopilot counters moved: {no_autopilot_counters}"}
+
+
+def drill_autopilot_ckpt_quarantine(tmp):
+    """Torn checkpoints xN -> storage quarantine: repeated armed
+    ``ckpt.write`` corruption drives the REAL integrity counters up, the
+    per-rank obs summary carries them (with the manager's storage path)
+    through the production beacon merge, the engine decides
+    ``quarantine_storage``, the actuator quarantines the path in the
+    checkpoint registry — and the SAME live manager's next save redirects,
+    after which restore lands on a verified step again."""
+    import jax.numpy as jnp
+
+    from bagua_tpu import checkpoint as ck
+    from bagua_tpu.autopilot import default_engine_actuators
+    from bagua_tpu.elastic import membership as mb
+    from bagua_tpu.obs import export as obs_export
+    from bagua_tpu.obs.export import build_fleet_record
+
+    ck.clear_quarantine()
+    obs_export.reset_local_summary()
+    d = os.path.join(tmp, "autopilot_ckpt")
+
+    def state(v):
+        return {"w": jnp.arange(4096, dtype=jnp.float32) * v,
+                "step": jnp.int32(0)}
+
+    mgr = ck.BaguaCheckpointManager(d, async_save=False, max_to_keep=8)
+    mgr.save(1, state(1.0))
+    before = telemetry.counters.snapshot()
+    with fault_scope(FaultSpec("ckpt.write", count=3)):
+        for i, v in ((2, 2.0), (3, 3.0), (4, 4.0)):
+            mgr.save(i, state(v))
+        step, restored = mgr.try_restore(state(0.0))
+        deltas = _counter_deltas(before)
+        detected = (
+            step == 1
+            and deltas.get("ckpt/integrity_failures", 0) >= 3
+            and deltas.get("ckpt/fallback_restores", 0) >= 1
+        )
+
+        # the evidence reaches the fleet snapshot through the production
+        # path: obs summary (integrity counters + storage path) -> beacon
+        # -> merged heartbeat payload -> coordinator merge
+        obs_export.note_step(4, 0.01)
+        beacon = os.path.join(tmp, "quarantine_beacon.r1")
+        mb.write_health_beacon(beacon)
+        record = build_fleet_record(
+            0, {0: None, 1: mb.merged_health_source([beacon])()})
+
+        engine = _autopilot_engine(
+            sustain=1, ckpt_failures=3,
+            actuators=default_engine_actuators(autotune_addr=None),
+        )
+        actions = engine.observe_snapshot(record)
+        decided = (
+            len(actions) == 1
+            and actions[0].kind == "quarantine_storage"
+            and str(actions[0].target) == ck._normalize_storage_path(d)
+        )
+        actuated = decided and ck.is_quarantined(d)
+
+        # recovery: the live manager's next save redirects off the rotten
+        # storage, and restore verifies again (no more fallback walking)
+        recovered = False
+        if actuated:
+            mgr.save(5, state(5.0))
+            redirected = mgr.directory == ck.redirect_directory(d)
+            before2 = telemetry.counters.snapshot()
+            step2, restored2 = mgr.try_restore(state(0.0))
+            deltas2 = _counter_deltas(before2)
+            recovered = (
+                redirected and step2 == 5
+                and np.array_equal(np.asarray(restored2["w"]),
+                                   np.asarray(state(5.0)["w"]))
+                and deltas2.get("ckpt/integrity_failures", 0) == 0
+                and deltas2.get("ckpt/verified_restores", 0) >= 1
+            )
+            if detected and recovered:
+                inject.record_recovery("ckpt.write")
+    mgr.close()
+    ck.clear_quarantine()
+    return {"injected": True,
+            "detected": bool(detected and decided),
+            "recovered": bool(actuated and recovered),
+            "decided_actions": [a.kind for a in actions],
+            "details": f"3 torn saves -> restore fell back to step {step} "
+                       f"with {deltas.get('ckpt/integrity_failures', 0)} "
+                       f"integrity failures; engine quarantined {d}; next "
+                       f"save redirected and restore verified step 5: "
+                       f"{recovered}"}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--only", nargs="+", default=None, metavar="DRILL",
@@ -868,6 +1336,17 @@ def main(argv=None):
             lambda: drill_straggler_throughput(tmp),
         "async_partition_staleness_catchup": drill_async_partition_catchup,
         "health_fence_flight_record": lambda: drill_health_fence(tmp),
+        # the fleet autopilot's policy matrix (docs/autopilot.md):
+        # injected -> detected -> DECIDED -> ACTUATED -> recovered
+        "autopilot_straggler_fence_resize":
+            lambda: drill_autopilot_straggler_fence(tmp),
+        "autopilot_victim_retune_hint":
+            lambda: drill_autopilot_victim_retune(tmp),
+        "autopilot_slo_escalation_ladder":
+            lambda: drill_autopilot_slo_ladder(tmp),
+        "autopilot_ckpt_quarantine":
+            lambda: drill_autopilot_ckpt_quarantine(tmp),
+        "autopilot_off_noop": drill_autopilot_off_noop,
     }
     if args.only:
         unknown = [n for n in args.only if n not in drills]

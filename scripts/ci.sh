@@ -52,6 +52,20 @@ python -m bagua_tpu.obs.ledger "$OBS_TMP/export" \
   --flight "$OBS_TMP/dumps" --check
 rm -rf "$OBS_TMP"
 
+echo "=== autopilot replay smoke (policy engine over a recorded fleet stream) ==="
+# The coordinator-side policy matrix in observe mode over the committed
+# fleet snapshot stream: the decided action plan (fence -> retune hint ->
+# two SLO ladder rungs -> storage quarantine) must match the committed
+# expectation exactly — a policy change that re-orders or drops an action
+# fails here before it ships.  Full matrix actuation is chaos-drilled in
+# CHAOS_DRILL.json (schema-gated in test_bench_sanity.py); operators can
+# replay their own streams with `python -m bagua_tpu.autopilot --replay`.
+python -m bagua_tpu.autopilot \
+  --replay tests/data/autopilot_fleet_stream.jsonl \
+  --expect tests/data/autopilot_expected_plan.json \
+  --sustain 2 --cooldown-s 0 --budget 8 --slo-goodput 0.5 \
+  --straggler-ratio 3.0 --ckpt-failures 3 --family async > /dev/null
+
 echo "=== serve smoke (continuous-batching engine, short synthetic trace) ==="
 # The serving plane end-to-end on the 8-dev cpu-sim image: weights loaded
 # through the integrity-verified serving loader, a short Poisson trace

@@ -187,6 +187,13 @@ def test_chaos_drill_artifact_schema():
         "straggler_throughput_degrades",
         "async_partition_staleness_catchup",
         "health_fence_flight_record",
+        # the fleet autopilot's policy matrix (ISSUE 13): every rule
+        # injected -> detected -> decided -> actuated -> recovered
+        "autopilot_straggler_fence_resize",
+        "autopilot_victim_retune_hint",
+        "autopilot_slo_escalation_ladder",
+        "autopilot_ckpt_quarantine",
+        "autopilot_off_noop",
     }
     assert required <= set(record["faults"]), sorted(record["faults"])
     for name, fault in record["faults"].items():
@@ -270,6 +277,33 @@ def test_chaos_drill_artifact_schema():
         assert led["delta_s"] > 0, (name, led)
     assert record["faults"]["nan_grad_skip_loss_continuity"]["ledger"][
         "rewind_windows_delta"] == 1
+    # the fleet autopilot (ISSUE 13): every policy rule decided the right
+    # action, each decision left an `autopilot_action` flight dump, the
+    # escalation ladder walked its rungs IN ORDER, and the telemetry trail
+    # recorded both the decisions and the actuations
+    autopilot_decisions = {
+        "autopilot_straggler_fence_resize": ["fence"],
+        "autopilot_victim_retune_hint": ["retune_hint"],
+        "autopilot_ckpt_quarantine": ["quarantine_storage"],
+    }
+    for name, kinds in autopilot_decisions.items():
+        fault = record["faults"][name]
+        assert fault["decided_actions"] == kinds, (name, fault)
+        assert fault["flight_record"]["trigger"] == "autopilot_action", name
+        assert fault["flight_record"]["schema_valid"] is True, name
+    ladder = record["faults"]["autopilot_slo_escalation_ladder"]
+    assert ladder["ladder_order"] == [
+        "retune_hint", "retune", "switch_family", "resize"], ladder
+    assert ladder["flight_record"]["schema_valid"] is True, ladder
+    # the off pin: BAGUA_AUTOPILOT=off leaves the compiled step (jaxpr-
+    # identical across modes) and the coordinator path untouched
+    off = record["faults"]["autopilot_off_noop"]
+    assert off["jaxpr_identical"] is True, off
+    for key in ("autopilot/decisions", "autopilot/actions_actuated",
+                "autopilot/fences", "autopilot/retunes",
+                "autopilot/family_switches", "autopilot/resizes",
+                "autopilot/quarantines"):
+        assert counters.get(key, 0) >= 1, key
 
 
 def test_bench_trend_artifact_schema():
