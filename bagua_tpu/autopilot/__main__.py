@@ -5,7 +5,8 @@ policy matrix.
         [--out DECISIONS.json] [--expect PLAN.json]
         [--slo-goodput F] [--sustain N] [--cooldown-s S] [--budget N]
         [--staleness-s S] [--straggler-ratio F] [--ckpt-failures N]
-        [--family NAME]
+        [--family NAME] [--historian] [--trend-window-s S]
+        [--dcn-share F] [--hbm-horizon-s S] [--compress-family NAME]
 
 ``SNAPSHOTS.jsonl``: one ``bagua-obs-fleet-v1`` record per line (the
 stream a coordinator's ``BAGUA_OBS_FLEET_OUT`` writer produced — tail the
@@ -19,6 +20,15 @@ exits non-zero on mismatch — the CI smoke gate.
 Policy knobs default to the ``BAGUA_AUTOPILOT_*`` env registry values;
 flags override (so an operator can ask "what WOULD a tighter SLO have
 done to yesterday's fleet?").
+
+``--historian`` replays the stream through a fresh telemetry historian
+(:mod:`bagua_tpu.obs.historian`) first — each snapshot is ingested and
+trend-augmented exactly as the live coordinator would, so the trend
+rules (pre-OOM resize on shrinking HBM headroom, DCN-dominance
+compression hint) can fire.  Deterministic: historian samples are
+timestamped by the records' own ``time_unix``.  Also on when
+``BAGUA_OBS_HISTORIAN=on``; raw (un-augmented) replays of streams whose
+snapshots already carry ``trends`` behave identically either way.
 """
 
 from __future__ import annotations
@@ -29,6 +39,7 @@ import sys
 from dataclasses import replace
 from typing import List
 
+from .. import env as _env
 from .engine import replay
 from .policy import config_from_env
 
@@ -80,6 +91,18 @@ def main(argv=None) -> int:
     ap.add_argument("--suspect-ttl-s", type=float, default=None)
     ap.add_argument("--ckpt-failures", type=int, default=None)
     ap.add_argument("--family", default=None)
+    ap.add_argument("--dcn-share", type=float, default=None)
+    ap.add_argument("--hbm-horizon-s", type=float, default=None)
+    ap.add_argument("--compress-family", default=None)
+    ap.add_argument("--historian", action="store_true",
+                    help="ingest the stream through a fresh telemetry "
+                         "historian first (trend-augmented snapshots, as "
+                         "the live coordinator would see them) — required "
+                         "for the hbm_exhaustion/dcn_dominance rules; "
+                         "also on when BAGUA_OBS_HISTORIAN=on")
+    ap.add_argument("--trend-window-s", type=float, default=None,
+                    help="historian trend window override "
+                         "(default BAGUA_OBS_HISTORIAN_WINDOW_S)")
     args = ap.parse_args(argv)
 
     config = config_from_env()
@@ -90,13 +113,22 @@ def main(argv=None) -> int:
         "straggler_ratio": args.straggler_ratio,
         "suspect_ttl_s": args.suspect_ttl_s,
         "ckpt_failures": args.ckpt_failures, "switch_family": args.family,
+        "dcn_share": args.dcn_share, "hbm_horizon_s": args.hbm_horizon_s,
+        "compress_family": args.compress_family,
     }
     config = replace(config, mode="observe",
                      **{k: v for k, v in overrides.items() if v is not None})
 
-    log = replay(_load_snapshots(args.replay), config)
+    historian = None
+    if args.historian or _env.is_obs_historian_on():
+        from ..obs.historian import Historian
+
+        historian = Historian(window_s=args.trend_window_s)
+
+    log = replay(_load_snapshots(args.replay), config, historian=historian)
     record = {
         "mode": "replay",
+        "historian": historian is not None,
         "config": {k: getattr(config, k)
                    for k in config.__dataclass_fields__},
         "decisions": log,

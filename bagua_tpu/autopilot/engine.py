@@ -85,6 +85,7 @@ _KIND_COUNTERS = {
     "switch_family": "autopilot/family_switches",
     "resize": "autopilot/resizes",
     "quarantine_storage": "autopilot/quarantines",
+    "compress_dcn": "autopilot/compress_hints",
 }
 
 #: core-bookkeeping key -> telemetry counter (diff-published per snapshot)
@@ -284,13 +285,14 @@ def default_engine_actuators(model_name: Optional[str] = None,
             "retune_hint": "autopilot_retune_hint",
             "retune": "autopilot_retune",
             "switch_family": "autopilot_switch_family",
+            "compress_dcn": "autopilot_compress_dcn",
         }
         hint = {
             "kind": kind_map[action.kind],
             "rule": action.rule,
             "reason": action.reason,
         }
-        if action.kind == "switch_family":
+        if action.kind in ("switch_family", "compress_dcn"):
             hint["family"] = action.target
         return deliver_hints_via_service(model, [hint], addr=autotune_addr)
 
@@ -304,21 +306,34 @@ def default_engine_actuators(model_name: Optional[str] = None,
         "retune_hint": _hint,
         "retune": _hint,
         "switch_family": _hint,
+        "compress_dcn": _hint,
         "quarantine_storage": _quarantine,
     }
 
 
 def replay(snapshots: List[dict], config: PolicyConfig,
-           state: Optional[PolicyState] = None) -> List[dict]:
+           state: Optional[PolicyState] = None,
+           historian=None) -> List[dict]:
     """Replay a recorded fleet snapshot stream against the policy matrix
     (operator CLI + the CI smoke stage).  Each snapshot is evaluated with
     ``now`` = its own ``time_unix`` (so a recorded stream replays
     identically regardless of when the operator runs it) and NOTHING
-    actuates — replay is a pure rehearsal.  Returns the decision log:
-    one entry per snapshot with the decided actions."""
+    actuates — replay is a pure rehearsal.  With ``historian`` (a fresh
+    :class:`bagua_tpu.obs.historian.Historian`), each snapshot is first
+    ingested and trend-augmented exactly as the live coordinator would —
+    the only way the trend rules (``hbm_exhaustion``/``dcn_dominance``)
+    can fire in a replay, and deterministic because historian samples are
+    timestamped by the records' own ``time_unix``.  Snapshots are
+    deep-copied before augmentation; the caller's stream is never
+    mutated.  Returns the decision log: one entry per snapshot with the
+    decided actions."""
+    import copy
+
     state = state or PolicyState()
     log: List[dict] = []
     for i, snap in enumerate(snapshots):
+        if historian is not None:
+            snap = historian.ingest(copy.deepcopy(snap))
         now = float(snap.get("time_unix") or 0.0)
         actions, state = decide(snap, state, config, now)
         log.append({

@@ -32,7 +32,22 @@ rule                   evidence (``bagua-obs-fleet-v1`` snapshot)  action
                                                                    resize
 ``ckpt_integrity``     a rank's integrity_failures +               storage
                        fallback_restores >= ckpt_failures          quarantine
+``hbm_exhaustion``     historian trend: negative HBM-headroom      pre-OOM
+                       slope projecting exhaustion within          resize
+                       hbm_horizon_s (trends.hbm_headroom_eta_s)   (node
+                       sustained ``sustain`` snapshots             removed)
+``dcn_dominance``      historian trend: DCN device seconds >=      compress
+                       dcn_share of the step wall                  hint
+                       (trends.dcn_comm_share) sustained           (slow
+                       ``sustain`` snapshots                       tier)
 =====================  ==========================================  =======
+
+The two trend rules consume the ``trends`` sub-dicts the telemetry
+historian (:mod:`bagua_tpu.obs.historian`) publishes into each rank's
+obs summary — windowed least-squares derivatives, not point-in-time
+readings.  Without the historian (``BAGUA_OBS_HISTORIAN=off``, the
+default) no snapshot carries trends and neither rule can fire: the
+rules are provably inert until the operator turns the memory on.
 
 Every rule carries hysteresis: ``sustain`` consecutive snapshots to
 trigger, per-action-kind cooldowns, and a global action budget.
@@ -60,7 +75,7 @@ __all__ = [
 
 #: every action kind the matrix can emit (cooldowns are tracked per kind)
 ACTION_KINDS = ("fence", "retune_hint", "retune", "switch_family",
-                "resize", "quarantine_storage")
+                "resize", "quarantine_storage", "compress_dcn")
 
 #: the SLO escalation ladder, cheapest adaptation first: rung N's action
 #: fires only after rung N-1 fired AND the breach sustained through a
@@ -98,6 +113,9 @@ class PolicyConfig:
     suspect_ttl_s: float = 120.0      # suspect evidence freshness
     ckpt_failures: int = 3            # integrity events before quarantine
     switch_family: str = "async"      # the ladder's switch rung target
+    dcn_share: float = 0.5            # trend rule: DCN share of the step
+    compress_family: str = "bytegrad"  # the compression hint's family
+    hbm_horizon_s: float = 600.0      # trend rule: pre-OOM projection
 
 
 def config_from_env() -> PolicyConfig:
@@ -112,6 +130,9 @@ def config_from_env() -> PolicyConfig:
         suspect_ttl_s=_env.get_autopilot_suspect_ttl_s(),
         ckpt_failures=_env.get_autopilot_ckpt_failures(),
         switch_family=_env.get_autopilot_family(),
+        dcn_share=_env.get_autopilot_dcn_share(),
+        compress_family=_env.get_autopilot_compress_family(),
+        hbm_horizon_s=_env.get_autopilot_hbm_horizon_s(),
     )
 
 
@@ -197,6 +218,23 @@ def _ckpt_evidence(snapshot: dict, config: PolicyConfig) -> List[dict]:
             if events >= config.ckpt_failures and path:
                 out.append({"node": int(node_id), "rank": rank_id,
                             "path": str(path), "events": events})
+    return out
+
+
+def _trend_evidence(snapshot: dict) -> List[dict]:
+    """Per-rank historian trends from the snapshot ((node, rank, trends)
+    records).  Present only when the telemetry historian augmented the
+    record — a point-in-time snapshot carries no trends and the trend
+    rules stay inert."""
+    out = []
+    for node_id, entry in (snapshot.get("ranks") or {}).items():
+        for rank_id, summary in (entry.get("obs") or {}).items():
+            if not isinstance(summary, dict):
+                continue
+            trends = summary.get("trends")
+            if isinstance(trends, dict) and trends:
+                out.append({"node": int(node_id), "rank": str(rank_id),
+                            "trends": trends})
     return out
 
 
@@ -309,6 +347,59 @@ def decide(snapshot: dict, state: PolicyState, config: PolicyConfig,
                 and int(k.split("/", 1)[1]) not in straggler_nodes]:
         state.streaks.pop(key, None)
 
+    trend_items = _trend_evidence(snapshot)
+
+    # ---- rule 1b: shrinking HBM headroom -> pre-OOM resize-down ---------
+    # historian evidence only: a rank whose windowed headroom slope is
+    # negative and projects exhaustion within the horizon gets its node
+    # removed at a restart boundary BEFORE the OOM kills the gang
+    # mid-collective (an OOM is a crash-loop; a resize is one rendezvous)
+    hbm_nodes: Dict[int, dict] = {}
+    if config.hbm_horizon_s > 0:
+        for item in trend_items:
+            trends = item["trends"]
+            eta = trends.get("hbm_headroom_eta_s")
+            slope = trends.get("hbm_headroom_slope")
+            if slope is None or slope >= 0 or eta is None:
+                continue
+            if eta <= config.hbm_horizon_s:
+                prev = hbm_nodes.get(item["node"])
+                if prev is None or eta < prev["trends"].get(
+                        "hbm_headroom_eta_s", float("inf")):
+                    hbm_nodes[item["node"]] = item
+    for node in sorted(hbm_nodes):
+        if node in fenced_nodes:
+            # already being removed this round — and its pending streak
+            # resets: "sustained" means CONSECUTIVE qualifying snapshots,
+            # and a fence interruption breaks the run (a frozen streak
+            # would let non-consecutive evidence satisfy the hysteresis)
+            state.streaks.pop(f"hbm/{node}", None)
+            continue
+        streak = _bump_streak(state, f"hbm/{node}", True)
+        if streak < config.sustain:
+            continue
+        why = _gate(state, config, "resize", now)
+        if why is not None:
+            continue
+        item = hbm_nodes[node]
+        eta = item["trends"].get("hbm_headroom_eta_s")
+        _emit(state, actions, Action(
+            kind="resize", rule="hbm_exhaustion", target=[node],
+            reason=(f"node {node} (rank {item['rank']}): HBM headroom "
+                    f"slope {item['trends'].get('hbm_headroom_slope'):.0f} "
+                    f"B/s projects exhaustion in {eta:.0f}s <= horizon "
+                    f"{config.hbm_horizon_s:.0f}s, sustained {streak} "
+                    "snapshots; resizing down before the OOM"),
+            evidence={"trend": item, "streak": streak},
+        ), now)
+        fenced_nodes.add(node)
+        state.streaks.pop(f"hbm/{node}", None)
+    # nodes whose headroom recovered: clear their streaks
+    for key in [k for k in state.streaks
+                if k.startswith("hbm/")
+                and int(k.split("/", 1)[1]) not in hbm_nodes]:
+        state.streaks.pop(key, None)
+
     # ---- rule 2: collective-dominant victim -> retune hint --------------
     # precedence: a fence beats a retune for the same rank — removing the
     # straggler already fixes its victims' waits, and any victim living on
@@ -331,6 +422,38 @@ def decide(snapshot: dict, state: PolicyState, config: PolicyConfig,
                 evidence={"suspects": evidence, "streak": streak},
             ), now)
             state.streaks.pop("victim", None)
+
+    # ---- rule 2b: sustained DCN dominance -> compression-family hint -----
+    # historian evidence only: when the windowed DCN share of the step
+    # wall sits at/above the threshold fleet-wide-anywhere, hint the
+    # autotune service toward the compression family whose hierarchical
+    # path compresses ONLY the slow cross-slice tier
+    # (docs/hierarchical.md) — the Bagua relaxation applied where bytes
+    # are most expensive.  A hint, never a forced switch: the service
+    # re-measures and the BO loop keeps the last word.
+    dcn_items = [
+        it for it in trend_items
+        if config.dcn_share > 0
+        and it["node"] not in fenced_nodes
+        and (it["trends"].get("dcn_comm_share") or 0.0) >= config.dcn_share
+    ]
+    streak = _bump_streak(state, "dcn", bool(dcn_items))
+    if dcn_items and streak >= config.sustain:
+        why = _gate(state, config, "compress_dcn", now)
+        if why is None:
+            shares = {it["rank"]: round(
+                it["trends"]["dcn_comm_share"], 3) for it in dcn_items}
+            _emit(state, actions, Action(
+                kind="compress_dcn", rule="dcn_dominance",
+                target=config.compress_family,
+                reason=(f"rank(s) {sorted(shares)} spend "
+                        f">= {config.dcn_share:.0%} of the step on the "
+                        f"DCN tier (shares {shares}) sustained {streak} "
+                        f"snapshots; hinting compression family "
+                        f"{config.compress_family!r} for the slow tier"),
+                evidence={"trends": dcn_items, "streak": streak},
+            ), now)
+            state.streaks.pop("dcn", None)
 
     # ---- rule 3: goodput SLO breach -> escalation ladder -----------------
     gf_min = _goodput_min(snapshot)

@@ -531,10 +531,16 @@ class BaguaTrainer:
         self._mem_poll_failures = 0
         if self._obs_enabled:
             from ..obs import export as _obs_export
+            from ..obs import http as _obs_http
             from ..obs import ledger as _obs_ledger
             from ..obs import recorder as _obs_recorder
 
             _obs_export.maybe_start_global_exporter(self)
+            # per-process HTTP status plane (off unless the operator sets
+            # BAGUA_OBS_HTTP_PORT; the launcher offsets each worker's
+            # port): /metrics serves the same prepared snapshot the
+            # exporter writes to metrics.prom
+            _obs_http.maybe_start_global_http_server()
             _obs_recorder.maybe_install_signal_hook()
             self._ledger = _obs_ledger.install()
             self._peak_flops = _obs_ledger.peak_flops_for_device_kind(

@@ -244,6 +244,14 @@ def build_env(args, local_rank: int, spec=None,
         env["BAGUA_CKPT_QUARANTINED_PATHS"] = "\n".join(
             str(p) for p in quarantined_ckpt_paths
         )
+    http_base = _env.get_obs_http_port()
+    if http_base > 0:
+        # HTTP status plane (docs/observability.md): the launcher keeps
+        # the base port for itself (the coordinator's /fleet + /history);
+        # each local worker gets a deterministic offset so one host's
+        # processes never race each other onto the same port (a lost
+        # race would still only degrade to an ephemeral port)
+        env["BAGUA_OBS_HTTP_PORT"] = str(http_base + 1 + local_rank)
     if args.simulate_cpu_devices:
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_PLATFORM_NAME"] = "cpu"
@@ -538,16 +546,22 @@ def publish_health_fence(client, epoch: int, tracker, unhealthy) -> str:
     return reason
 
 
-def _maybe_write_fleet_snapshot(spec, tracker, want_record=False):
+def _maybe_write_fleet_snapshot(spec, tracker, want_record=False,
+                                historian=None, fleet_holder=None):
     """Coordinator-side fleet view: merge every member's latest heartbeat
     health payload into one ``bagua-obs-fleet-v1`` record; written to
     ``BAGUA_OBS_FLEET_OUT`` when set, and RETURNED — the autopilot
     (``want_record=True``) consumes the same record the snapshot file
-    carries (one merge, one truth).  With neither consumer the merge is
-    skipped entirely (the pre-autopilot no-op monitor tick).
+    carries (one merge, one truth).  The telemetry historian (when on)
+    ingests the record FIRST and augments it with per-rank ``trends`` —
+    so the snapshot file, the autopilot's trend rules, and the HTTP
+    plane's ``/fleet`` endpoint (fed via ``fleet_holder``) all see the
+    identical trend-annotated record.  With no consumer at all the merge
+    is skipped entirely (the pre-autopilot no-op monitor tick).
     Exception-free (None on failure) — the caller is the monitor loop."""
     out = _env.get_obs_fleet_out()
-    if not out and not want_record:
+    if not out and not want_record and historian is None \
+            and fleet_holder is None:
         return None
     try:
         from ..obs.export import build_fleet_record, write_fleet_snapshot
@@ -556,6 +570,10 @@ def _maybe_write_fleet_snapshot(spec, tracker, want_record=False):
             spec.epoch,
             {nid: tracker.health_of(nid) for nid in spec.ranks},
         )
+        if historian is not None:
+            record = historian.ingest(record)
+        if fleet_holder is not None:
+            fleet_holder["record"] = record
         if out:
             write_fleet_snapshot(out, spec.epoch, record=record)
         return record
@@ -588,7 +606,8 @@ def publish_autopilot_stop(client, epoch: int, action, nodes) -> str:
 
 
 def monitor_elastic(args, procs, client, spec, coordinator, tracker,
-                    autopilot=None) -> int:
+                    autopilot=None, historian=None,
+                    fleet_holder=None) -> int:
     """Monitor one elastic attempt.  Every launcher: watch local workers +
     the per-epoch stop flag.  The coordinator additionally: expire silent
     members' leases, scan for standby joiners (scale-up requests) — each
@@ -653,7 +672,8 @@ def monitor_elastic(args, procs, client, spec, coordinator, tracker,
                             rejoin=False, nodes=expired,
                         )
                     fleet_record = _maybe_write_fleet_snapshot(
-                        spec, tracker, want_record=autopilot is not None)
+                        spec, tracker, want_record=autopilot is not None,
+                        historian=historian, fleet_holder=fleet_holder)
                     if autopilot is not None and fleet_record is not None:
                         # the policy engine evaluates the SAME merged view
                         # the snapshot file carries; it actuates the
@@ -749,6 +769,7 @@ def run_elastic(args) -> int:
 
     is_coord = args.node_rank == 0
     server = None
+    http_server = None
     if is_coord:
         server = TCPStoreServer(host="0.0.0.0",
                                 port=args.restart_coordinator_port)
@@ -765,6 +786,8 @@ def run_elastic(args) -> int:
         client = mb.MembershipClient(store, args.node_rank, args.max_nnodes)
         coordinator = None
         autopilot = None
+        historian = None
+        fleet_holder = None
         if is_coord:
             coordinator = ElasticCoordinator(
                 client, args.min_nnodes, args.max_nnodes,
@@ -792,6 +815,32 @@ def run_elastic(args) -> int:
                 )
                 logger.info("fleet autopilot: %s mode",
                             autopilot.config.mode)
+            # fleet telemetry historian (docs/observability.md): ONE set
+            # of time-series rings across every epoch, persisted through
+            # the restart store so a relaunched coordinator keeps its
+            # trend windows instead of re-earning them; a misconfigured
+            # knob degrades to "historian off" with a warning, never a
+            # dead coordinator
+            from ..obs.historian import maybe_build_historian
+
+            historian = maybe_build_historian(store=store)
+            if historian is not None:
+                logger.info("telemetry historian: on (window %.0fs, "
+                            "%d samples/series)", historian.window_s,
+                            historian.capacity)
+            if _env.get_obs_http_port() > 0:
+                # HTTP status plane: the coordinator serves the fleet
+                # routes (/fleet from the latest monitor-tick merge,
+                # /history from the historian) on top of the per-process
+                # ones; workers start their own servers at bring-up on
+                # the build_env-offset ports
+                from ..obs.http import maybe_start_global_http_server
+
+                fleet_holder = {"record": None}
+                http_server = maybe_start_global_http_server(
+                    fleet_provider=lambda: fleet_holder["record"],
+                    historian=historian,
+                )
         epoch = 0
         restarts_used = 0
         expect = None
@@ -890,7 +939,8 @@ def run_elastic(args) -> int:
             try:
                 rc = monitor_elastic(
                     args, procs, client, spec, coordinator, tracker,
-                    autopilot=autopilot)
+                    autopilot=autopilot, historian=historian,
+                    fleet_holder=fleet_holder)
                 try:
                     client.publish_done(spec.epoch)
                     if is_coord:
@@ -990,6 +1040,8 @@ def run_elastic(args) -> int:
                 hb.stop()
     finally:
         _dump_elastic_telemetry(transitions)
+        if http_server is not None:
+            http_server.stop()
         if server is not None:
             server.stop()
 

@@ -220,6 +220,53 @@ _declare("BAGUA_OBS_ANOMALY_WARMUP", "int", "16",
 _declare("BAGUA_OBS_ANOMALY_THRESHOLD", "float", "5.0",
          "Robust-z threshold (MAD multiples) a step's raw cadence must "
          "exceed over the rolling median to count as anomalous.")
+_declare("BAGUA_OBS_DUMP_MAX_FILES", "int", "64",
+         "Retention cap for flight-recorder dumps under "
+         "BAGUA_OBS_DUMP_DIR: when a new dump would leave more than this "
+         "many flight_*.json files, the oldest (by mtime) are pruned "
+         "first (counted in obs/flight_dumps_pruned).  Dumps are already "
+         "overwritten per (trigger, fault point, rank, pid), so growth "
+         "comes from restarts minting new pids — a long run with "
+         "recurring throttled faults previously accumulated dumps "
+         "without limit.  0 disables pruning (unbounded).")
+_declare("BAGUA_OBS_HTTP_PORT", "int", "0",
+         "Port of the per-process HTTP status plane "
+         "(bagua_tpu.obs.http): `/metrics` serves the SAME Prometheus "
+         "text the exporter writes to metrics.prom, `/healthz` liveness, "
+         "`/ledger` the goodput report; the elastic coordinator "
+         "additionally serves `/fleet` (latest bagua-obs-fleet-v1 "
+         "snapshot) and `/history?metric=&window=` (historian windows).  "
+         "0 (default) disables the server; a taken port falls back to an "
+         "ephemeral one (logged, and published as the obs/http_port "
+         "gauge).  The elastic launcher offsets each local worker's port "
+         "(base + 1 + local_rank) so one host's processes never collide.")
+_declare("BAGUA_OBS_HTTP_ADDR", "str", "127.0.0.1",
+         "Bind address of the HTTP status plane.  The default stays on "
+         "loopback — expose it beyond the host deliberately (0.0.0.0) "
+         "only where the network is trusted; the endpoints are "
+         "read-only but unauthenticated.")
+_declare("BAGUA_OBS_HISTORIAN", "enum", "off",
+         "Coordinator-side fleet telemetry historian "
+         "(bagua_tpu.obs.historian): bounded per-rank per-metric "
+         "time-series rings fed by the beacon->heartbeat obs summaries "
+         "in every fleet snapshot, with windowed rate/percentile/"
+         "least-squares-slope queries.  Publishes derived trend gauges "
+         "(obs/goodput_slope, obs/hbm_headroom_slope, "
+         "obs/dcn_comm_share) back into the snapshot — the evidence the "
+         "autopilot's trend rules (pre-OOM resize, DCN compression "
+         "escalation) consume — and persists its rings through the "
+         "restart TCPStore so a relaunched coordinator keeps history.",
+         choices=("off", "on"))
+_declare("BAGUA_OBS_HISTORIAN_CAPACITY", "int", "512",
+         "Samples retained per (rank, metric) historian ring; the oldest "
+         "drop first.  At the default ~1/s monitor cadence this is ~8.5 "
+         "minutes of full-rate history per series (slower snapshot "
+         "writers keep proportionally longer windows).")
+_declare("BAGUA_OBS_HISTORIAN_WINDOW_S", "float", "600",
+         "Trend window in seconds: slopes, percentiles, and the DCN "
+         "comm share are computed over the trailing window of this "
+         "length (the `sustained` horizon behind obs/goodput_slope and "
+         "friends; /history defaults to it too).")
 # -- serving plane (docs/serving.md) --
 _declare("BAGUA_SERVE_MAX_SLOTS", "int", "8",
          "Batch slots of the continuous-batching inference engine: the "
@@ -314,6 +361,28 @@ _declare("BAGUA_AUTOPILOT_MODEL", "str", "bagua_module",
          "Autotune task (model_name) the autopilot's perf hints and "
          "family-switch commands address — the BaguaTrainer model_name "
          "default unless the job names its model.")
+_declare("BAGUA_AUTOPILOT_DCN_SHARE", "float", "0.5",
+         "DCN-dominance threshold for the autopilot's trend rule: when "
+         "the historian's obs/dcn_comm_share (windowed mean DCN device "
+         "seconds over windowed mean step time) sits at or above this "
+         "fraction for BAGUA_AUTOPILOT_SUSTAIN snapshots, the autopilot "
+         "emits a compression-family escalation hint — compress the slow "
+         "tier (docs/hierarchical.md).  Requires the historian "
+         "(BAGUA_OBS_HISTORIAN=on): without trend windows the rule never "
+         "fires.  0 disables the rule.")
+_declare("BAGUA_AUTOPILOT_COMPRESS_FAMILY", "str", "bytegrad",
+         "Compression algorithm family the DCN-dominance hint names "
+         "(its hierarchical path compresses only the cross-slice DCN "
+         "stage; delivered as an autotune perf hint, never a forced "
+         "switch).")
+_declare("BAGUA_AUTOPILOT_HBM_HORIZON_S", "float", "600",
+         "Pre-OOM horizon for the autopilot's HBM trend rule: when a "
+         "rank's historian headroom slope (obs/hbm_headroom_slope) is "
+         "negative and projects exhaustion within this many seconds "
+         "(headroom / -slope), sustained BAGUA_AUTOPILOT_SUSTAIN "
+         "snapshots, the autopilot resizes that node away BEFORE the "
+         "OOM kills the gang mid-collective.  Requires the historian; "
+         "0 disables the rule.")
 _declare("BAGUA_CKPT_QUARANTINED_PATHS", "str", "",
          "Newline-separated checkpoint directories under storage "
          "quarantine (newline, not os.pathsep — ':' appears inside "
@@ -681,6 +750,36 @@ def get_obs_anomaly_threshold() -> float:
     return env_float("BAGUA_OBS_ANOMALY_THRESHOLD")
 
 
+def get_obs_dump_max_files() -> int:
+    """Flight-dump retention cap (0 = unbounded)."""
+    return env_int("BAGUA_OBS_DUMP_MAX_FILES")
+
+
+def get_obs_http_port() -> int:
+    """HTTP status-plane port (0 = server disabled)."""
+    return env_int("BAGUA_OBS_HTTP_PORT")
+
+
+def get_obs_http_addr() -> str:
+    """HTTP status-plane bind address (default loopback)."""
+    return env_str("BAGUA_OBS_HTTP_ADDR")
+
+
+def is_obs_historian_on() -> bool:
+    """Whether the coordinator-side telemetry historian is enabled."""
+    return env_enum("BAGUA_OBS_HISTORIAN") == "on"
+
+
+def get_obs_historian_capacity() -> int:
+    """Samples retained per (rank, metric) historian ring."""
+    return env_int("BAGUA_OBS_HISTORIAN_CAPACITY")
+
+
+def get_obs_historian_window_s() -> float:
+    """Trend window (seconds) for historian slope/percentile queries."""
+    return env_float("BAGUA_OBS_HISTORIAN_WINDOW_S")
+
+
 def get_serve_max_slots() -> int:
     """Batch slots of the continuous-batching serving engine."""
     return env_int("BAGUA_SERVE_MAX_SLOTS")
@@ -766,6 +865,21 @@ def get_autopilot_family() -> str:
 def get_autopilot_model() -> str:
     """Autotune task (model_name) autopilot hints address."""
     return env_str("BAGUA_AUTOPILOT_MODEL")
+
+
+def get_autopilot_dcn_share() -> float:
+    """DCN-dominance share threshold for the trend rule (0 = off)."""
+    return env_float("BAGUA_AUTOPILOT_DCN_SHARE")
+
+
+def get_autopilot_compress_family() -> str:
+    """Compression family the DCN-dominance hint names."""
+    return env_str("BAGUA_AUTOPILOT_COMPRESS_FAMILY")
+
+
+def get_autopilot_hbm_horizon_s() -> float:
+    """Pre-OOM projection horizon for the HBM trend rule (0 = off)."""
+    return env_float("BAGUA_AUTOPILOT_HBM_HORIZON_S")
 
 
 def get_ckpt_quarantined_paths() -> list:

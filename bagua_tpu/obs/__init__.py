@@ -39,6 +39,18 @@ And the efficiency plane over all of it:
   footprint (exact on cpu-sim), per-step-cache ``memory_analysis()``,
   live ``device.memory_stats()`` peaks/headroom on real TPU.
 
+And the fleet-historical layer (ISSUE 14):
+
+* :mod:`~bagua_tpu.obs.historian` — coordinator-side time-series rings
+  over the fleet-snapshot stream with windowed rate/percentile/slope
+  queries; publishes trend gauges (``obs/goodput_slope``,
+  ``obs/hbm_headroom_slope``, ``obs/dcn_comm_share``) back into each
+  snapshot and persists through the restart store.
+* :mod:`~bagua_tpu.obs.http` — per-process HTTP status plane
+  (``/metrics`` from the same prepared snapshot as ``metrics.prom``,
+  ``/healthz``, ``/ledger``; the coordinator adds ``/fleet`` and
+  ``/history``), gated by ``BAGUA_OBS_HTTP_PORT``.
+
 Master switch: ``BAGUA_OBS`` (default on; ``off`` restores the exact
 pre-obs host behavior — the compiled step program is identical either way).
 Import-light: no jax anywhere in the package (``attribution``/``regress``
@@ -54,6 +66,8 @@ from .export import (  # noqa: F401
     write_fleet_snapshot,
 )
 from .export import LEDGER_CLASSES  # noqa: F401
+from .historian import Historian, maybe_build_historian  # noqa: F401
+from .http import ObsHTTPServer, maybe_start_global_http_server  # noqa: F401
 from .memory import live_memory_stats, plan_flat_bytes, static_footprint  # noqa: F401
 from .recorder import (  # noqa: F401
     dump_flight_record,

@@ -70,7 +70,8 @@ def _ledger():
 __all__ = [
     "METRIC_REGISTRY", "Metric", "LEDGER_CLASSES",
     "is_registered", "any_registered_matches",
-    "MetricsExporter", "render_prometheus", "local_obs_summary",
+    "MetricsExporter", "render_prometheus", "prepared_snapshot",
+    "local_obs_summary",
     "note_step", "note_step_metrics", "note_anomaly",
     "note_device_attribution", "last_device_attribution",
     "note_mfu", "last_mfu", "note_hbm_footprint", "last_hbm_footprint",
@@ -173,6 +174,18 @@ for _point in FAULT_POINTS:
 # -- observability plane self-accounting --
 _declare("obs/flight_dumps", "counter",
          "Flight-recorder post-mortem dumps written.")
+_declare("obs/flight_dumps_pruned", "counter",
+         "Flight-recorder dumps removed by the BAGUA_OBS_DUMP_MAX_FILES "
+         "retention cap (oldest-first; a long run with recurring "
+         "throttled faults no longer grows the dump dir without limit).")
+_declare("obs/http_requests", "counter",
+         "Requests served by this process's HTTP status plane "
+         "(bagua_tpu.obs.http: /metrics, /healthz, /ledger, and the "
+         "coordinator's /fleet and /history).")
+_declare("obs/http_port", "gauge",
+         "Port the HTTP status plane actually bound (differs from "
+         "BAGUA_OBS_HTTP_PORT when the configured port was taken and "
+         "the server fell back to an ephemeral one).")
 _declare("obs/export_snapshots", "counter",
          "Metrics-exporter snapshots written (jsonl line + prom file).")
 _declare("obs/spans_dropped", "gauge",
@@ -237,6 +250,25 @@ _declare("obs/hbm_peak_bytes", "gauge",
 _declare("obs/hbm_headroom_bytes", "gauge",
          "bytes_limit minus the live peak from the last memory poll — the "
          "capacity-planning margin (real TPU only).")
+# -- telemetry historian trend gauges (coordinator-side; docs/observability
+# -- .md): windowed derivatives over the fleet-snapshot stream, published
+# -- back into each snapshot and consumed by the autopilot's trend rules
+_declare("obs/goodput_slope", "gauge",
+         "Fleet-worst least-squares slope of goodput_fraction per second "
+         "over the historian's trend window (BAGUA_OBS_HISTORIAN_WINDOW_S)"
+         " — negative and sustained means the fleet is losing efficiency, "
+         "before any absolute SLO trips.")
+_declare("obs/hbm_headroom_slope", "gauge",
+         "Fleet-worst least-squares slope of the live HBM headroom in "
+         "bytes per second over the historian's trend window — a negative "
+         "slope projects exhaustion (headroom / -slope seconds out), the "
+         "evidence behind the autopilot's pre-OOM resize rule.")
+_declare("obs/dcn_comm_share", "gauge",
+         "Fleet-worst share of the step wall spent in cross-slice DCN "
+         "device seconds (windowed mean device_comm_dcn_s_per_step over "
+         "windowed mean step_dt_p50) — the number the hierarchical "
+         "two-level decomposition exists to shrink; sustained dominance "
+         "triggers the autopilot's compression-escalation hint.")
 
 
 # -- fleet autopilot (docs/autopilot.md) --
@@ -274,6 +306,11 @@ _declare("autopilot/family_switches", "counter",
 _declare("autopilot/resizes", "counter",
          "Escalation-ladder terminal resize decisions (worst-goodput "
          "node removed through the fence/epoch machinery).")
+_declare("autopilot/compress_hints", "counter",
+         "DCN-dominance trend-rule decisions: compression-family "
+         "escalation hints (compress the slow cross-slice tier) delivered "
+         "through the autotune perf-hint channel.  Fires only from "
+         "historian trend windows (BAGUA_OBS_HISTORIAN=on).")
 _declare("autopilot/quarantines", "counter",
          "Checkpoint storage paths quarantined after repeated integrity "
          "failures/fallback restores (saves redirect).")
@@ -658,6 +695,21 @@ def render_prometheus(snapshot: Dict[str, Any]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def prepared_snapshot():
+    """The ONE counters snapshot both Prometheus surfaces render: the
+    exporter's ``metrics.prom`` file and the HTTP plane's ``/metrics``
+    endpoint (:mod:`bagua_tpu.obs.http`).  Refreshes the derived gauges
+    first — ring drop pressure (a truncated timeline must read as
+    truncated, not as a quiet run) and the goodput ledger's cumulative
+    class/goodput gauges — so a live scrape and the on-disk file always
+    expose the same series set."""
+    from . import spans as _spans
+
+    counters.set_gauge("obs/spans_dropped", _spans.recorder.dropped)
+    _ledger().publish_gauges(counters)
+    return counters.snapshot()
+
+
 def _maybe_rotate(path: str) -> None:
     """Size-capped rotation for the append-only ``metrics.jsonl``: once the
     file reaches ``BAGUA_OBS_EXPORT_MAX_BYTES`` it moves to ``<path>.1``
@@ -720,15 +772,7 @@ class MetricsExporter:
     def export_once(self) -> dict:
         """One snapshot (also the thread's body): returns the JSONL record
         for tests/round-trips."""
-        from . import spans as _spans
-
-        # ring drop pressure rides every snapshot: a truncated timeline
-        # must read as truncated, not as a quiet run
-        counters.set_gauge("obs/spans_dropped", _spans.recorder.dropped)
-        # goodput ledger: refresh the cumulative class/goodput gauges so
-        # every metrics.jsonl line carries a consistent efficiency snapshot
-        _ledger().publish_gauges(counters)
-        snap = counters.snapshot()
+        snap = prepared_snapshot()
         record: Dict[str, Any] = {
             "time_unix": time.time(),
             "collected_at": snap.collected_at,

@@ -160,6 +160,7 @@ def dump_flight_record(trigger: str, reason: str = "",
             _export._atomic_write(
                 path, json.dumps(record, indent=1, sort_keys=True)
             )
+            _prune_dumps(dump_dir, keep=path)
         counters.incr_many({"obs/flight_dumps": 1})
         logger.warning("flight recorder: %s dump written to %s", trigger,
                        path)
@@ -167,6 +168,53 @@ def dump_flight_record(trigger: str, reason: str = "",
     except Exception as e:  # noqa: BLE001 - a dying process must still die
         logger.warning("flight recorder dump failed: %s", e)
         return None
+
+
+def _prune_dumps(dump_dir: str, keep: str) -> None:
+    """Retention cap for the dump directory (``BAGUA_OBS_DUMP_MAX_FILES``,
+    0 = unbounded): dumps are overwritten per (trigger, fault point,
+    rank, pid), so growth comes from restarts minting fresh pids — a long
+    run with recurring throttled faults used to accumulate dumps without
+    limit.  Oldest-first by mtime, never the file just written; pruned
+    count lands in ``obs/flight_dumps_pruned``.  Caller holds
+    ``_DUMP_LOCK``; only ``flight_*.json`` files are candidates (span-ring
+    ``spans_*.json`` dumps live in the same directory and are not ours to
+    reap)."""
+    max_files = _env.get_obs_dump_max_files()
+    if max_files <= 0:
+        return
+    try:
+        entries = []
+        with os.scandir(dump_dir) as it:
+            for entry in it:
+                if not entry.name.startswith("flight_") \
+                        or not entry.name.endswith(".json"):
+                    continue
+                try:
+                    entries.append((entry.stat().st_mtime, entry.path))
+                except OSError:
+                    continue  # vanished between scandir and stat
+        excess = len(entries) - max_files
+        if excess <= 0:
+            return
+        pruned = 0
+        keep = os.path.abspath(keep)
+        for _, victim in sorted(entries):
+            if pruned >= excess:
+                break
+            if os.path.abspath(victim) == keep:
+                continue
+            try:
+                os.unlink(victim)
+                pruned += 1
+            except OSError:
+                continue
+        if pruned:
+            counters.incr_many({"obs/flight_dumps_pruned": pruned})
+            logger.info("flight recorder: pruned %d dump(s) over the "
+                        "%d-file retention cap", pruned, max_files)
+    except OSError as e:  # pragma: no cover - directory-level races
+        logger.debug("flight dump pruning skipped: %s", e)
 
 
 _LAST_FIRE_DUMP: Dict[str, float] = {}

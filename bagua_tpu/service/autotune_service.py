@@ -159,9 +159,22 @@ class AutotuneService:
           NORMAL recommendation path (``_maybe_switch_algorithm`` — a
           re-jit plus a queued state migration, never a restart), and the
           per-train_iter decision cache keeps the switch SPMD-uniform.
+        * ``autopilot_compress_dcn`` — the DCN-dominance trend hint:
+          re-grant the once-per-point re-measure (the dominance evidence
+          taints the current window's score) and log the suggested
+          compression family.  A hint, never a pin — the BO loop keeps
+          the last word on whether compressing the slow tier actually
+          wins on this workload.
         """
         kind = hint.get("kind")
-        if kind == "autopilot_retune":
+        if kind == "autopilot_compress_dcn":
+            task.sample_retried = False
+            logger.info(
+                "autotune[%s]: autopilot reports sustained DCN dominance; "
+                "suggested compression family %r (re-measure re-granted)",
+                task.model_name, hint.get("family"),
+            )
+        elif kind == "autopilot_retune":
             task.sample_retried = False
             if task.completed and task.extra_samples < 16:
                 task.extra_samples += 4

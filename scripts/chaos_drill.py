@@ -152,6 +152,7 @@ FLIGHT_EXPECTATIONS = {
     "autopilot_slo_escalation_ladder": {"trigger": "autopilot_action"},
     "autopilot_ckpt_quarantine": {"fault_point": "ckpt.write",
                                   "trigger": "autopilot_action"},
+    "autopilot_trend_rules": {"trigger": "autopilot_action"},
 }
 
 
@@ -1298,6 +1299,141 @@ def drill_autopilot_ckpt_quarantine(tmp):
                        f"{recovered}"}
 
 
+def drill_autopilot_trend_rules(tmp):
+    """Historian trend windows close the loop over DCN and HBM signals
+    (ISSUE 14): a synthetic degradation stream — node 1's HBM headroom
+    shrinking toward exhaustion, node 2's steps DCN-dominated, node 3 a
+    flat control — flows through the LIVE telemetry historian (restart-
+    store-persisted) into the act-mode engine.  The pre-OOM resize
+    decides from the projected-exhaustion window and actuates through
+    the production stop publisher (the world resizes BEFORE the OOM);
+    the compression-escalation hint is delivered to a live autotune
+    service as the controller rank and re-grants the re-measure; the
+    flat control never fires; and a relaunched historian resumes its
+    rings from the store."""
+    import threading
+
+    from bagua_tpu.autopilot import default_engine_actuators
+    from bagua_tpu.contrib.utils.store import InMemoryStore
+    from bagua_tpu.elastic import membership as mb
+    from bagua_tpu.obs.historian import Historian
+    from bagua_tpu.service.autotune_service import (
+        AutotuneService,
+        make_server,
+    )
+
+    service = AutotuneService(
+        world_size=1, autotune_level=1, max_samples=10,
+        sampling_confidence_time_s=0.0, warmup_time_s=0.0,
+    )
+    server = make_server(0, service)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    model = "autopilot_trend_drill"
+    store = InMemoryStore()
+    historian = Historian(capacity=64, window_s=600.0, store=store,
+                          persist_every=1)
+    engine = _autopilot_engine(
+        sustain=2, cooldown_s=300.0,
+        actuators=default_engine_actuators(
+            model_name=model, autotune_addr=f"127.0.0.1:{port}"),
+    )
+
+    def rank_obs(rank, step, headroom, dcn):
+        return {"rank": rank, "step": step, "goodput_fraction": 0.9,
+                "step_dt_p50": 0.1, "hbm_headroom_bytes": headroom,
+                "device_comm_dcn_s_per_step": dcn,
+                "device_comm_ici_s_per_step": 0.01}
+
+    def fleet_record(i):
+        from bagua_tpu.obs.export import build_fleet_record
+
+        record = build_fleet_record(0, {0: None})
+        record["ranks"] = {
+            # node 1: headroom collapsing — the polls are ~20 ms apart,
+            # so the fitted slope is steep and exhaustion projects well
+            # inside the 600 s horizon
+            "1": {"health": {}, "obs": {"1": rank_obs(
+                1, 100 + i, 4.0e9 - i * 3.0e8, 0.005)}},
+            # node 2: 70% of the step wall is DCN device seconds
+            "2": {"health": {}, "obs": {"2": rank_obs(
+                2, 100 + i, 8.0e9, 0.07)}},
+            # node 3: flat control — must never fire a rule
+            "3": {"health": {}, "obs": {"3": rank_obs(
+                3, 100 + i, 8.0e9, 0.005)}},
+        }
+        record["nnodes"] = 3
+        return record
+
+    all_actions = []
+    try:
+        task = service._task(model)
+        task.sample_retried = True  # a spent re-measure the hint re-grants
+        for i in range(8):
+            time.sleep(0.02)  # distinct snapshot time_unix per poll
+            record = historian.ingest(fleet_record(i))
+            all_actions.extend(engine.observe_snapshot(record))
+        kinds = [a.kind for a in all_actions]
+        resize = [a for a in all_actions if a.kind == "resize"]
+        compress = [a for a in all_actions if a.kind == "compress_dcn"]
+        trends = record["ranks"]["1"]["obs"]["1"].get("trends") or {}
+        detected = (
+            trends.get("hbm_headroom_slope", 0) < 0
+            and trends.get("hbm_headroom_eta_s") is not None
+            and (record["ranks"]["2"]["obs"]["2"]["trends"]
+                 ["dcn_comm_share"]) >= 0.5
+        )
+        decided = (
+            kinds == ["resize", "compress_dcn"]
+            and resize[0].rule == "hbm_exhaustion"
+            and resize[0].target == [1]
+            and compress[0].rule == "dcn_dominance"
+            and compress[0].target == "bytegrad"
+            and not any("3" == str(n) for a in all_actions
+                        for n in (a.target if isinstance(a.target, list)
+                                  else []))
+        )
+        stop, survivors = (None, None)
+        delivered = regranted = False
+        if decided:
+            stop, survivors = _actuate_autopilot_stop(resize[0])
+            engine.note_actuated(resize[0])
+            with task.lock:
+                delivered = any(
+                    h.get("kind") == "autopilot_compress_dcn"
+                    and h.get("family") == "bytegrad"
+                    and h.get("reported_by") == -1
+                    for h in task.perf_hints
+                )
+            regranted = task.sample_retried is False
+        actuated = bool(
+            stop and stop["kind"] == mb.STOP_HEALTH and stop["nodes"] == [1]
+            and stop["rejoin"] is False
+        )
+        # a relaunched coordinator's historian resumes the trend windows
+        resumed = Historian(capacity=64, window_s=600.0, store=store)
+        persisted = (
+            resumed.slope("1", "hbm_headroom_bytes") is not None
+            and resumed.slope("1", "hbm_headroom_bytes") < 0
+        )
+    finally:
+        server.shutdown()
+    recovered = bool(actuated and survivors == {0} and delivered
+                     and regranted and persisted)
+    return {"injected": True,
+            "detected": bool(detected and decided),
+            "recovered": recovered,
+            "decided_actions": kinds,
+            "details": f"historian trends (headroom slope "
+                       f"{trends.get('hbm_headroom_slope')} B/s, eta "
+                       f"{trends.get('hbm_headroom_eta_s')}s) -> "
+                       f"pre-OOM resize of node 1 (world -> "
+                       f"{sorted(survivors or [])}); DCN share 0.7 -> "
+                       f"bytegrad compression hint delivered={delivered} "
+                       f"re-measure re-granted={regranted}; historian "
+                       f"resumed from store={persisted}"}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--only", nargs="+", default=None, metavar="DRILL",
@@ -1346,6 +1482,8 @@ def main(argv=None):
             lambda: drill_autopilot_slo_ladder(tmp),
         "autopilot_ckpt_quarantine":
             lambda: drill_autopilot_ckpt_quarantine(tmp),
+        "autopilot_trend_rules":
+            lambda: drill_autopilot_trend_rules(tmp),
         "autopilot_off_noop": drill_autopilot_off_noop,
     }
     if args.only:

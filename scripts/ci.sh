@@ -38,6 +38,14 @@ BAGUA_OBS_EXPORT_DIR="$OBS_TMP/export" BAGUA_OBS_EXPORT_INTERVAL_S=1 \
 python scripts/chaos_drill.py --only nan_grad_skip_loss_continuity \
   --dump-dir "$OBS_TMP/dumps"
 
+echo "=== obs HTTP plane smoke (live /metrics + /fleet scrape) ==="
+# The HTTP status plane scraped DURING a live cpu-sim training run: the
+# /metrics scrape must parse as fully registered+typed Prometheus text
+# and match the concurrent on-disk metrics.prom series-for-series, and
+# /fleet must validate against the bagua-obs-fleet-v1 schema with the
+# historian's trend augmentation aboard (ISSUE 14).
+python scripts/obs_http_smoke.py --export-dir "$OBS_TMP/http_export"
+
 echo "=== fleet timeline from the drill's flight dumps ==="
 # The dumps the smoke trace just wrote must assemble into a schema-valid,
 # clock-aligned Perfetto trace — the analysis layer's own end-to-end gate.
@@ -65,6 +73,19 @@ python -m bagua_tpu.autopilot \
   --expect tests/data/autopilot_expected_plan.json \
   --sustain 2 --cooldown-s 0 --budget 8 --slo-goodput 0.5 \
   --straggler-ratio 3.0 --ckpt-failures 3 --family async > /dev/null
+
+echo "=== autopilot trend-rule replay (historian windows close the loop) ==="
+# The historian-backed trend rules over the committed synthetic stream
+# (ISSUE 14): the shrinking-HBM-headroom rank decides the pre-OOM
+# resize, the DCN-dominant rank decides the compression-escalation
+# hint, and the flat control rank decides NOTHING — and without
+# --historian the same stream is provably inert (the rules fire only
+# from historian trend windows, gated in tests/test_autopilot.py).
+python -m bagua_tpu.autopilot \
+  --replay tests/data/autopilot_trend_stream.jsonl \
+  --expect tests/data/autopilot_trend_plan.json \
+  --historian --trend-window-s 600 \
+  --sustain 2 --cooldown-s 300 --budget 8 > /dev/null
 
 echo "=== serve smoke (continuous-batching engine, short synthetic trace) ==="
 # The serving plane end-to-end on the 8-dev cpu-sim image: weights loaded

@@ -49,6 +49,8 @@ MODULES = [
     "bagua_tpu.obs.regress",
     "bagua_tpu.obs.ledger",
     "bagua_tpu.obs.memory",
+    "bagua_tpu.obs.historian",
+    "bagua_tpu.obs.http",
     "bagua_tpu.autopilot.policy",
     "bagua_tpu.autopilot.engine",
     "bagua_tpu.profiling",

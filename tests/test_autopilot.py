@@ -61,9 +61,23 @@ def _config(**kw):
     base = dict(mode="observe", sustain=3, cooldown_s=60.0, budget=8,
                 staleness_s=60.0, slo_goodput=0.0, straggler_ratio=3.0,
                 suspect_ttl_s=120.0, ckpt_failures=3,
-                switch_family="async")
+                switch_family="async", dcn_share=0.5,
+                compress_family="bytegrad", hbm_horizon_s=600.0)
     base.update(kw)
     return PolicyConfig(**base)
+
+
+def _trend_snapshot(t, trends_by_node, epoch=0):
+    """A fleet record whose rank summaries carry historian ``trends``
+    sub-dicts (what :meth:`Historian.ingest` publishes)."""
+    snap = _snapshot(t, epoch=epoch)
+    for node, trends in trends_by_node.items():
+        entry = snap["ranks"].setdefault(str(node), {"health": {}, "obs": {}})
+        entry["obs"][str(node)] = {
+            "rank": node, "step": 10, "goodput_fraction": 0.9,
+            "trends": dict(trends),
+        }
+    return snap
 
 
 def _run(snaps, config, state=None):
@@ -246,6 +260,222 @@ def test_ckpt_quarantine_threshold_and_idempotence():
                                      "ckpt_directory": "/data/ckpt"})
     a, state = decide(again, state, cfg, NOW + 2)
     assert a == [] and state.quarantined == ["/data/ckpt"]
+
+
+# ---- historian trend rules (ISSUE 14) --------------------------------------
+
+_SHRINKING = {"hbm_headroom_slope": -2e8, "hbm_headroom_eta_s": 15.0,
+              "window_s": 600.0}
+_DCN_HEAVY = {"dcn_comm_share": 0.7, "window_s": 600.0}
+
+
+def test_hbm_exhaustion_resize_after_sustain():
+    """Shrinking headroom projecting exhaustion inside the horizon,
+    sustained -> pre-OOM resize naming the node; the streak must be
+    earned like every other rule's."""
+    cfg = _config(sustain=3, hbm_horizon_s=600.0)
+    snaps = [_trend_snapshot(NOW + i, {2: _SHRINKING}) for i in range(3)]
+    kinds, _ = _run(snaps, cfg)
+    assert kinds == [[], [], ["resize"]]
+    a, _ = decide(snaps[0], PolicyState(), cfg, NOW)
+    assert a == []  # fresh state: one snapshot is never enough
+    # the fired action names the node and the rule
+    _, state = _run(snaps[:2], cfg)
+    actions, _ = decide(snaps[2], state, cfg, NOW + 2)
+    assert actions[0].kind == "resize"
+    assert actions[0].rule == "hbm_exhaustion"
+    assert actions[0].target == [2]
+    assert "exhaustion" in actions[0].reason
+
+
+def test_hbm_rule_requires_projection_inside_horizon():
+    cfg = _config(sustain=1)
+    # positive slope: headroom growing, nothing to do
+    a, _ = decide(_trend_snapshot(NOW, {2: {"hbm_headroom_slope": 2e8}}),
+                  PolicyState(), cfg, NOW)
+    assert a == []
+    # negative slope but projection beyond the horizon
+    far = {"hbm_headroom_slope": -1e3, "hbm_headroom_eta_s": 90000.0}
+    a, _ = decide(_trend_snapshot(NOW, {2: far}), PolicyState(), cfg, NOW)
+    assert a == []
+    # horizon 0 disables the rule outright
+    a, _ = decide(_trend_snapshot(NOW, {2: _SHRINKING}), PolicyState(),
+                  _config(sustain=1, hbm_horizon_s=0.0), NOW)
+    assert a == []
+
+
+def test_hbm_streak_resets_when_headroom_recovers():
+    cfg = _config(sustain=3)
+    snaps = [
+        _trend_snapshot(NOW + 0, {2: _SHRINKING}),
+        _trend_snapshot(NOW + 1, {2: _SHRINKING}),
+        _trend_snapshot(NOW + 2, {2: {"hbm_headroom_slope": 1e8}}),
+        _trend_snapshot(NOW + 3, {2: _SHRINKING}),
+        _trend_snapshot(NOW + 4, {2: _SHRINKING}),
+    ]
+    kinds, _ = _run(snaps, cfg)
+    assert kinds == [[], [], [], [], []]
+
+
+def test_fence_beats_hbm_resize_for_same_node():
+    """A node already being fenced this round must not also be resized
+    by the HBM rule (one removal, one reason)."""
+    cfg = _config(sustain=1)
+    snap = _snapshot(NOW, suspects=[(2, "dispatch", 10.0)])
+    snap["ranks"]["2"]["obs"]["2"]["trends"] = dict(_SHRINKING)
+    actions, _ = decide(snap, PolicyState(), cfg, NOW)
+    assert [a.kind for a in actions] == ["fence"]
+
+
+def test_hbm_streak_resets_when_fence_interrupts():
+    """A fence interruption breaks the hbm sustain run — the streak must
+    reset, not freeze: 'sustained' means CONSECUTIVE snapshots, and a
+    frozen streak would fire the resize from non-consecutive evidence."""
+    cfg = _config(sustain=2, cooldown_s=0.0)
+
+    def hbm_snap(t, suspect=False):
+        snap = _snapshot(
+            t, suspects=[(2, "dispatch", 10.0)] if suspect else ())
+        entry = snap["ranks"].setdefault("2", {"health": {}, "obs": {}})
+        obs = entry["obs"].setdefault("2", {
+            "rank": 2, "step": 10, "goodput_fraction": 0.9})
+        obs["trends"] = dict(_SHRINKING)
+        return snap
+
+    state = PolicyState()
+    kinds = []
+    # snap 0: hbm streak 1; snap 1: fence fires (straggler sustained 2
+    # via its own streak? no — suspect present both snaps)
+    for i, suspect in enumerate((True, True, False, False)):
+        actions, state = decide(hbm_snap(NOW + i, suspect), state, cfg,
+                                NOW + i)
+        kinds.append([a.kind for a in actions])
+    # snap 1 fences node 2 and RESETS the pending hbm streak; snaps 2-3
+    # re-earn a full consecutive window before the resize fires
+    assert kinds == [[], ["fence"], [], ["resize"]]
+
+
+def test_dcn_dominance_compress_hint_after_sustain():
+    cfg = _config(sustain=2, dcn_share=0.5, compress_family="bytegrad")
+    snaps = [_trend_snapshot(NOW + i, {3: _DCN_HEAVY}) for i in range(2)]
+    kinds, _ = _run(snaps, cfg)
+    assert kinds == [[], ["compress_dcn"]]
+    _, state = _run(snaps[:1], cfg)
+    actions, _ = decide(snaps[1], state, cfg, NOW + 1)
+    assert actions[0].rule == "dcn_dominance"
+    assert actions[0].target == "bytegrad"  # the slow-tier codec family
+    assert "DCN" in actions[0].reason
+
+
+def test_dcn_rule_below_share_or_disabled_is_inert():
+    mild = {"dcn_comm_share": 0.2, "window_s": 600.0}
+    a, _ = decide(_trend_snapshot(NOW, {3: mild}), PolicyState(),
+                  _config(sustain=1), NOW)
+    assert a == []
+    # dcn_share 0 disables the rule even under total dominance
+    a, _ = decide(_trend_snapshot(NOW, {3: {"dcn_comm_share": 1.0}}),
+                  PolicyState(), _config(sustain=1, dcn_share=0.0), NOW)
+    assert a == []
+
+
+def test_trend_rules_inert_without_historian_trends():
+    """The acceptance boundary: raw point-in-time evidence (headroom and
+    DCN gauges WITHOUT a trends sub-dict) never fires the trend rules —
+    only historian windows do."""
+    cfg = _config(sustain=1)
+    snap = _snapshot(NOW)
+    snap["ranks"]["2"] = {"health": {}, "obs": {"2": {
+        "rank": 2, "step": 10, "goodput_fraction": 0.9,
+        "hbm_headroom_bytes": 1e6,            # nearly exhausted...
+        "device_comm_dcn_s_per_step": 0.09,   # ...and DCN-swamped
+        "step_dt_p50": 0.1,
+    }}}
+    kinds, state = _run([snap] * 1, cfg)
+    assert kinds == [[]]
+    assert state.streaks == {}
+
+
+def test_replay_with_historian_fires_trend_rules():
+    """The acceptance scenario end-to-end: a synthetic shrinking-headroom
+    stream decides the pre-OOM resize, a DCN-dominant stream decides the
+    compression hint, flat streams decide nothing — all through the SAME
+    replay entry point the CLI uses."""
+    from bagua_tpu.obs.historian import Historian
+
+    def snap(i, headroom=None, dcn=None):
+        obs = {"rank": 1, "step": 10 + i, "goodput_fraction": 0.9,
+               "step_dt_p50": 0.1}
+        if headroom is not None:
+            obs["hbm_headroom_bytes"] = headroom
+        if dcn is not None:
+            obs["device_comm_dcn_s_per_step"] = dcn
+            obs["device_comm_ici_s_per_step"] = 0.01
+        return {"schema": "bagua-obs-fleet-v1", "time_unix": NOW + i,
+                "epoch": 0, "nnodes": 1,
+                "ranks": {"2": {"health": {}, "obs": {"1": obs}}},
+                "efficiency": {"ranks": {}, "goodput_fraction_min": 0.9,
+                               "goodput_fraction_mean": 0.9}}
+
+    cfg = _config(mode="observe", sustain=2, cooldown_s=300.0)
+    shrink = [snap(i, headroom=5e9 - i * 2e8) for i in range(8)]
+    log = replay(shrink, cfg, historian=Historian(window_s=600.0))
+    fired = [(e["snapshot"], a["kind"], a["rule"])
+             for e in log for a in e["actions"]]
+    assert fired == [(4, "resize", "hbm_exhaustion")]
+    assert shrink[4]["ranks"]["2"]["obs"]["1"].get("trends") is None  # pure
+
+    dcn = [snap(i, dcn=0.08) for i in range(8)]
+    log = replay(dcn, cfg, historian=Historian(window_s=600.0))
+    fired = [(e["snapshot"], a["kind"]) for e in log for a in e["actions"]]
+    assert fired == [(4, "compress_dcn")]
+
+    flat = [snap(i, headroom=5e9, dcn=0.01) for i in range(8)]
+    log = replay(flat, cfg, historian=Historian(window_s=600.0))
+    assert [a for e in log for a in e["actions"]] == []
+    # and WITHOUT the historian the same shrinking stream decides nothing
+    log = replay(shrink, cfg)
+    assert [a for e in log for a in e["actions"]] == []
+
+
+def test_committed_trend_fixture_matches_plan(tmp_path):
+    """The committed CI fixture (scripts/ci.sh trend-replay stage) stays
+    green through the pytest gate too."""
+    from bagua_tpu.autopilot.__main__ import main as cli_main
+
+    data = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+    rc = cli_main(["--replay",
+                   os.path.join(data, "autopilot_trend_stream.jsonl"),
+                   "--expect",
+                   os.path.join(data, "autopilot_trend_plan.json"),
+                   "--historian", "--trend-window-s", "600",
+                   "--sustain", "2", "--cooldown-s", "300",
+                   "--budget", "8"])
+    assert rc == 0
+
+
+def test_engine_counts_compress_hints(tmp_path, monkeypatch):
+    from bagua_tpu.telemetry import counters
+
+    eng, spy = _engine(tmp_path, monkeypatch, "act", sustain=1)
+    before = counters.get("autopilot/compress_hints")
+    actions = eng.observe_snapshot(_trend_snapshot(NOW, {3: _DCN_HEAVY}),
+                                   now=NOW)
+    assert [a.kind for a in actions] == ["compress_dcn"]
+    assert [a.kind for a in spy.calls] == ["compress_dcn"]
+    assert counters.get("autopilot/compress_hints") == before + 1
+
+
+def test_service_compress_hint_regrants_remeasure():
+    svc = _service()
+    task = svc._task("m")
+    task.sample_retried = True
+    svc.report_metrics({"model_name": "m", "rank": -1, "train_iter": -1,
+                        "hyperparameters": {}, "speed": 0.0,
+                        "perf_hints": [{"kind": "autopilot_compress_dcn",
+                                        "family": "bytegrad"}]})
+    assert task.sample_retried is False  # the hint re-granted re-measure
+    assert task.pinned_algorithm is None  # a hint, never a pin
+    assert task.perf_hints[0]["family"] == "bytegrad"
 
 
 def test_staleness_guard_refuses_old_snapshot():

@@ -193,6 +193,7 @@ def test_chaos_drill_artifact_schema():
         "autopilot_victim_retune_hint",
         "autopilot_slo_escalation_ladder",
         "autopilot_ckpt_quarantine",
+        "autopilot_trend_rules",
         "autopilot_off_noop",
     }
     assert required <= set(record["faults"]), sorted(record["faults"])
@@ -285,6 +286,10 @@ def test_chaos_drill_artifact_schema():
         "autopilot_straggler_fence_resize": ["fence"],
         "autopilot_victim_retune_hint": ["retune_hint"],
         "autopilot_ckpt_quarantine": ["quarantine_storage"],
+        # the historian trend rules (ISSUE 14): pre-OOM resize from the
+        # shrinking-headroom window, compression-escalation hint from
+        # sustained DCN dominance — both from historian windows only
+        "autopilot_trend_rules": ["resize", "compress_dcn"],
     }
     for name, kinds in autopilot_decisions.items():
         fault = record["faults"][name]

@@ -202,6 +202,38 @@ def test_flight_dump_flushes_elastic_counters(obs_on, tmp_path, monkeypatch):
     assert flushed["counters"].get("comm/aborts", 0) >= 1
 
 
+def test_flight_dump_retention_cap_prunes_oldest(obs_on, tmp_path,
+                                                 monkeypatch):
+    """The BAGUA_OBS_DUMP_MAX_FILES satellite: dump files over the cap
+    are pruned oldest-first (never the one just written), the pruned
+    count lands in obs/flight_dumps_pruned, and span-ring dumps in the
+    same directory are not touched."""
+    monkeypatch.setenv("BAGUA_OBS_DUMP_DIR", str(tmp_path))
+    monkeypatch.setenv("BAGUA_OBS_DUMP_MAX_FILES", "3")
+    bystander = tmp_path / "spans_rank0.json"
+    bystander.write_text("{}")
+    before = telemetry.counters.get("obs/flight_dumps_pruned")
+    paths = []
+    for i in range(6):
+        # distinct fault points mint distinct trigger-keyed filenames
+        p = obs_recorder.dump_flight_record("fault_fire", reason=f"d{i}",
+                                            fault_point=f"point.{i}")
+        assert p is not None
+        paths.append(p)
+    remaining = sorted(glob.glob(os.path.join(tmp_path, "flight_*.json")))
+    assert len(remaining) == 3
+    assert paths[-1] in remaining  # the newest write survives
+    assert paths[0] not in remaining and paths[1] not in remaining
+    assert bystander.exists()  # not ours to reap
+    assert telemetry.counters.get("obs/flight_dumps_pruned") == before + 3
+    # cap 0 disables pruning
+    monkeypatch.setenv("BAGUA_OBS_DUMP_MAX_FILES", "0")
+    for i in range(6, 9):
+        obs_recorder.dump_flight_record("fault_fire", reason=f"d{i}",
+                                        fault_point=f"point.{i}")
+    assert len(glob.glob(os.path.join(tmp_path, "flight_*.json"))) == 6
+
+
 def test_flight_dump_disabled_modes(obs_on, tmp_path, monkeypatch):
     monkeypatch.delenv("BAGUA_OBS_DUMP_DIR", raising=False)
     monkeypatch.delenv("BAGUA_ELASTIC_TELEMETRY_OUT", raising=False)
@@ -246,6 +278,24 @@ def test_prometheus_rendering_kinds_and_mangling():
     assert "# TYPE bagua_faults_grad_poison_fired counter" in prom
     assert "# TYPE bagua_async_staleness_max gauge" in prom
     assert "# TYPE bagua_not_a_registered_name untyped" in prom
+
+
+def test_prepared_snapshot_renders_fully_registered_prom():
+    """Satellite gate (file side): the prepared snapshot both Prometheus
+    surfaces render — metrics.prom and the /metrics endpoint — exposes
+    only registered series, each with HELP and TYPE (never untyped)."""
+    telemetry.counters.incr("obs/flight_dumps")
+    snap = obs_export.prepared_snapshot()
+    assert snap, "prepared snapshot should carry at least the gauges"
+    for name in snap:
+        assert obs_export.is_registered(name), name
+    prom = obs_export.render_prometheus(snap)
+    assert "untyped" not in prom
+    for name in snap:
+        pname = obs_export.prometheus_name(name)
+        kind = obs_export.METRIC_REGISTRY[name].kind
+        assert f"# TYPE {pname} {kind}" in prom
+        assert f"# HELP {pname} " in prom
 
 
 def test_metric_registry_covers_known_names():
