@@ -22,11 +22,18 @@ collectives through ``ctx``.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+#: comm-axes tuples a dropped-codec warning was already logged for (the
+#: warning fires at trace time, once per topology, not once per bucket)
+_CODEC_DROP_WARNED: set = set()
 
 from ..bucket import BucketPlan
 from ..communication import BaguaCommunicator, ReduceOp
@@ -63,6 +70,59 @@ class AlgorithmContext:
     #: :meth:`bucket_flats` / :meth:`from_bucket_flats` so one stage
     #: implementation serves both layouts
     flat_resident: bool = False
+    #: per-link-class codec policy (docs/compression.md): what the ring
+    #: hops of each bandwidth tier carry on the wire.  Values are the
+    #: ``BAGUA_COMPRESS_{INTRA,INTER}`` knob values — ``auto`` (default)
+    #: defers to the algorithm family's own wire codec (ByteGrad/QAdam
+    #: compress the DCN tier natively; everything else stays full
+    #: precision), ``off`` FORCES full precision on the tier, and a codec
+    #: name forces that codec for every family riding the tier.
+    intra_codec: Optional[str] = None
+    inter_codec: Optional[str] = None
+
+    def codec_for(self, link_class: str, family_default=None):
+        """Resolve the wire codec for one link class: the tier's policy
+        knob where it names a codec or forces ``off``, else the algorithm
+        family's default (``None`` = full precision).  ``LINK_DCN``
+        compressed / ``LINK_ICI`` full-precision is the default posture —
+        only the compression families carry a DCN family default, and
+        ``auto`` never compresses ICI."""
+        from ..communication import LINK_DCN
+
+        knob = (self.inter_codec if link_class == LINK_DCN
+                else self.intra_codec)
+        if knob in (None, "", "auto"):
+            return family_default
+        if knob == "off":
+            return None
+        return knob
+
+    def flat_ring_codec(self, warn: bool = True):
+        """The knob-resolved codec for the FLAT (whole-comm-world) ring —
+        or None when this comm world cannot ride a ring (multiple mesh
+        axes, or a single rank).  The ring is the only compressed carrier
+        on the flat path, so a knob-forced codec there must either engage
+        the ring or be LOUDLY dropped — and the byte accounting uses the
+        same resolution, so it can never claim a wire reduction the
+        collective did not deliver."""
+        from ..communication import LINK_ICI
+
+        codec = self.codec_for(LINK_ICI, None)
+        if codec is None:
+            return None
+        if len(self.comm.axes) == 1 and self.comm.nranks() > 1:
+            return codec
+        if warn and self.comm.nranks() > 1 \
+                and self.comm.axes not in _CODEC_DROP_WARNED:
+            _CODEC_DROP_WARNED.add(self.comm.axes)
+            logger.warning(
+                "compress_intra=%r ignored: the flat comm world spans "
+                "mesh axes %s and the compressed ring permutes over "
+                "exactly one — this collective stays full precision "
+                "(use hierarchical=True with compress_inter to compress "
+                "the cross-slice tier)", codec, self.comm.axes,
+            )
+        return None
 
     def bucket_flats(self, tree) -> List:
         """The per-bucket flat gradient/param/state buffers of ``tree``
@@ -147,52 +207,77 @@ class AlgorithmContext:
 
     # -- per-tier stage helpers (shared by allreduce/bytegrad/zero) --------
 
-    def tier_reduce_scatter(self, flat, op: ReduceOp):
+    def tier_reduce_scatter(self, flat, op: ReduceOp, codec=None):
         """Slice-local (ICI) reduce-scatter of ``flat`` — this rank's
-        contiguous 1/intra chunk, ring-chunked against the ICI target."""
+        contiguous 1/intra chunk, ring-chunked against the ICI target.
+        The ICI codec policy resolves against ``codec`` as the family
+        default (full precision unless the knob names a codec — ICI bytes
+        are cheap)."""
         from ..communication import LINK_ICI
 
+        codec = self.codec_for(LINK_ICI, codec)
         k = self._comm_chunks(self.intranode, flat.shape[0],
                               flat.dtype.itemsize, LINK_ICI)
+        if codec is not None:
+            return self.intranode.ring_reduce_scatter(
+                flat, op, num_chunks=k, codec=codec
+            )
         if k > 1:
             return self.intranode.ring_reduce_scatter(flat, op, num_chunks=k)
         return self.intranode.reduce_scatter(flat, op)
 
-    def tier_allreduce(self, chunk, op: ReduceOp):
+    def tier_allreduce(self, chunk, op: ReduceOp, codec=None):
         """Cross-slice (DCN) allreduce of this rank's shard, ring-chunked
         against the DCN target — the only stage whose bytes cross the slow
-        link."""
+        link, and therefore the stage the codec policy compresses: with a
+        resolved codec the shard rides the compressed ring (quantized
+        ppermute hops, fp32 accumulation), so compressed bytes are what
+        actually cross DCN."""
         from ..communication import LINK_DCN
 
+        codec = self.codec_for(LINK_DCN, codec)
         k = self._comm_chunks(self.internode, chunk.shape[0],
                               chunk.dtype.itemsize, LINK_DCN)
+        if codec is not None:
+            return self.internode.ring_allreduce(
+                chunk, op, num_chunks=k, codec=codec
+            )
         if k > 1:
             return self.internode.ring_allreduce(chunk, op, num_chunks=k)
         return self.internode.allreduce(chunk, op)
 
-    def tier_allgather(self, chunk):
+    def tier_allgather(self, chunk, codec=None):
         """Slice-local (ICI) allgather of this rank's chunk back to the
         full flat — same chunk gate as :meth:`tier_reduce_scatter` (sized
         on the full flat the chunk tiles) so the pair stays
         layout-symmetric."""
         from ..communication import LINK_ICI
 
+        codec = self.codec_for(LINK_ICI, codec)
         k = self._comm_chunks(
             self.intranode, chunk.shape[0] * self.intranode.nranks(),
             chunk.dtype.itemsize, LINK_ICI,
         )
+        if codec is not None:
+            return self.intranode.ring_allgather(chunk, num_chunks=k,
+                                                 codec=codec)
         if k > 1:
             return self.intranode.ring_allgather(chunk, num_chunks=k)
         return self.intranode.allgather(chunk, axis=0, tiled=True)
 
-    def two_level_allreduce(self, flat, op: ReduceOp):
+    def two_level_allreduce(self, flat, op: ReduceOp, dcn_codec=None):
         """The two-level hierarchical allreduce of one flat buffer:
         reduce-scatter over ``intra``, allreduce the 1/intra shard over
         ``inter``, allgather over ``intra``.  Buffers the intra world does
         not divide are zero-padded internally (sound for SUM/AVG) and
         sliced back.  AVG divides ONCE by the comm world after the summing
         stages — the same single division the flat ``pmean`` applies, so
-        the only difference from the flat path is sum association order."""
+        the only difference from the flat path is sum association order.
+        ``dcn_codec`` is the family default for the DCN stage (the codec
+        policy's ``auto`` resolution); with a codec the DCN ring's
+        broadcast phase quantizes the UNDIVIDED inter-sum and the world
+        division scales the dequantized fp32 afterwards — quantization is
+        scale-invariant, so this equals dividing first."""
         assert op in (ReduceOp.SUM, ReduceOp.AVG), op
         n_intra = self.intranode.nranks()
         size = flat.shape[0]
@@ -206,13 +291,14 @@ class AlgorithmContext:
                 [flat, jnp.zeros((pad,), flat.dtype)]
             )
         chunk = self.tier_reduce_scatter(flat, ReduceOp.SUM)
-        chunk = self.tier_allreduce(chunk, ReduceOp.SUM)
+        chunk = self.tier_allreduce(chunk, ReduceOp.SUM, codec=dcn_codec)
         if op == ReduceOp.AVG:
             chunk = chunk / self.world_size
         full = self.tier_allgather(chunk)
         return full[:size] if pad else full
 
-    def hierarchical_allreduce(self, flat, op: ReduceOp, hierarchical: bool):
+    def hierarchical_allreduce(self, flat, op: ReduceOp, hierarchical: bool,
+                               dcn_codec=None):
         """Hierarchical = the two-level decomposition above (DCN carries the
         1/intra shard); non-hierarchical = one fused collective over the
         whole comm world.  Ops beyond SUM/AVG (and non-flat operands) keep
@@ -222,19 +308,31 @@ class AlgorithmContext:
         if op not in (ReduceOp.SUM, ReduceOp.AVG) or jnp.ndim(flat) != 1:
             flat = self.intranode.allreduce(flat, op)
             return self.internode.allreduce(flat, op)
-        return self.two_level_allreduce(flat, op)
+        return self.two_level_allreduce(flat, op, dcn_codec)
 
-    def bucket_allreduce(self, flat, op: ReduceOp, hierarchical: bool):
+    def bucket_allreduce(self, flat, op: ReduceOp, hierarchical: bool,
+                         dcn_codec=None):
         """One bucket's gradient allreduce under the active comm config:
         the two-level decomposition on hierarchical two-tier meshes
-        (per-tier ring chunking when the overlap scheduler set targets),
-        the chunked double-buffered ring when a chunk size is set on a
-        single-axis comm world, else the fused psum path.  The serialized
-        non-hierarchical construction (``overlap=off``) always takes the
-        fused psum path."""
+        (per-tier ring chunking when the overlap scheduler set targets,
+        compressed DCN hops when the codec policy resolves one), the
+        chunked double-buffered ring when a chunk size OR flat codec is
+        set on a single-axis comm world, else the fused psum path.  The
+        serialized non-hierarchical construction (``overlap=off``, codec
+        knobs at default) always takes the fused psum path."""
         if hierarchical and self.two_tier():
-            return self.hierarchical_allreduce(flat, op, True)
+            return self.hierarchical_allreduce(flat, op, True, dcn_codec)
+        flat_codec = self.flat_ring_codec()
         k = self._ring_chunks(flat.shape[0], flat.dtype.itemsize)
+        if flat_codec is not None:
+            # a forced flat codec rides the ring for hierarchical
+            # families too: past the branch above the hierarchical flag
+            # is inert (two_tier() failed — hierarchical_allreduce would
+            # lower the same fused psum), and the byte accounting
+            # resolves through the identical flat_ring_codec gate, so
+            # honoring the knob here is what keeps the spans truthful
+            return self.comm.ring_allreduce(flat, op, num_chunks=k,
+                                            codec=flat_codec)
         if k > 1 and not hierarchical:
             return self.comm.ring_allreduce(flat, op, num_chunks=k)
         return self.hierarchical_allreduce(flat, op, hierarchical)
@@ -242,8 +340,15 @@ class AlgorithmContext:
     def bucket_reduce_scatter(self, flat, op: ReduceOp):
         """One bucket's reduce-scatter (ZeRO's grad half) under the active
         comm config; chunk layout is identical between the ring and
-        ``psum_scatter`` paths (rank r owns the r-th contiguous slice)."""
+        ``psum_scatter`` paths (rank r owns the r-th contiguous slice).
+        A knob-forced flat codec compresses these rings too — every
+        family riding the flat tier honors the forced policy, so the byte
+        accounting's claim stays true for ZeRO's scatter/gather dance."""
+        codec = self.flat_ring_codec()
         k = self._ring_chunks(flat.shape[0], flat.dtype.itemsize)
+        if codec is not None:
+            return self.comm.ring_reduce_scatter(flat, op, num_chunks=k,
+                                                 codec=codec)
         if k > 1:
             return self.comm.ring_reduce_scatter(flat, op, num_chunks=k)
         return self.comm.reduce_scatter(flat, op)
@@ -253,15 +358,29 @@ class AlgorithmContext:
         flat), chunked-ring under the active comm config — same gate as
         :meth:`bucket_reduce_scatter` (sized on the full flat the chunk
         tiles) so the pair stays layout-symmetric."""
+        codec = self.flat_ring_codec()
         k = self._ring_chunks(chunk.shape[0] * self.comm.nranks(),
                               chunk.dtype.itemsize)
+        if codec is not None:
+            return self.comm.ring_allgather(chunk, num_chunks=k,
+                                            codec=codec)
         if k > 1:
             return self.comm.ring_allgather(chunk, num_chunks=k)
         return self.comm.allgather(chunk, axis=0, tiled=True)
 
     # -- bandwidth-tier-aware launch schedule ------------------------------
 
-    def bucket_tier_bytes(self, index: int, hierarchical: bool = True) -> dict:
+    def _wire_bytes(self, numel: int, itemsize: int, codec_name) -> int:
+        """Host-side wire bytes of one ``numel``-element operand under a
+        resolved codec name (None = full precision)."""
+        if codec_name is None:
+            return int(numel) * int(itemsize)
+        from ..compression.codecs import get_codec
+
+        return get_codec(codec_name).wire_bytes(int(numel))
+
+    def bucket_tier_bytes(self, index: int, hierarchical: bool = True,
+                          dcn_codec=None, flat_codec=None) -> dict:
         """Host-side per-tier bytes-on-wire estimate for one bucket's
         gradient collective under the ACTIVE config (ring model: a tier's
         allreduce moves ``2(n-1)/n`` of its operand, a scatter/gather half
@@ -272,43 +391,94 @@ class AlgorithmContext:
         On a two-tier mesh with ``hierarchical=False``, ``dcn_bytes``
         reports the slow-link bytes the flat collective DOES pay there
         (its full operand crosses the slice boundary) — the comparison
-        number the two-level decomposition is judged against."""
+        number the two-level decomposition is judged against.
+
+        ``dcn_codec``/``flat_codec`` are the algorithm family's wire-codec
+        defaults (``Algorithm.wire_codec_dcn``/``wire_codec_flat``); the
+        tier knobs override them through :meth:`codec_for`, and the
+        estimate then reports COMPRESSED wire bytes — so the launch spans,
+        the DCN-first launch order, and ``obs/device_comm_dcn_s``
+        attribution describe what actually crosses the wire, not the fp32
+        operand the codec replaced."""
         import numpy as np
 
         b = self.plan.buckets[index]
-        nbytes = int(b.padded_numel * np.dtype(b.dtype).itemsize)
+        from ..communication import LINK_DCN, LINK_ICI
+
+        itemsize = int(np.dtype(b.dtype).itemsize)
+        numel = int(b.padded_numel)
+        nbytes = numel * itemsize
+        # the flat wire codec resolved exactly as the COLLECTIVES resolve
+        # it — the accounting must never report compressed bytes the wire
+        # did not carry.  A scatter-gather family (flat_codec set)
+        # compresses on any comm world with its own pipeline unless the
+        # knob forces `off` (a forced codec NAME keeps the family's
+        # minmax pipeline — one wire format there); an exact family
+        # compresses only when the knob names a codec AND the flat ring
+        # can carry it (flat_ring_codec's validity gate).
+        if flat_codec is not None:
+            resolved_flat = (
+                flat_codec
+                if self.codec_for(LINK_ICI, flat_codec) is not None
+                else None
+            )
+        else:
+            resolved_flat = self.flat_ring_codec(warn=False)
         if not self.two_tier():
+            wire = self._wire_bytes(numel, itemsize, resolved_flat)
             return {"tier": "flat", "bytes": nbytes,
-                    "ici_bytes": nbytes, "dcn_bytes": 0}
+                    "ici_bytes": wire, "dcn_bytes": 0,
+                    "dcn_codec": None,
+                    "flat_codec": resolved_flat}
         if not hierarchical:
             ne = self.internode.nranks()
+            wire = self._wire_bytes(numel, itemsize, resolved_flat)
             return {"tier": "flat", "bytes": nbytes,
-                    "ici_bytes": nbytes,
-                    "dcn_bytes": int(2 * nbytes * (ne - 1) // ne)}
+                    "ici_bytes": wire,
+                    "dcn_bytes": int(2 * wire * (ne - 1) // ne),
+                    "dcn_codec": resolved_flat,
+                    "flat_codec": resolved_flat}
         ni = self.intranode.nranks()
         ne = self.internode.nranks()
-        shard = -(-nbytes // ni)
+        resolved_dcn = self.codec_for(LINK_DCN, dcn_codec)
+        # the intra tier is single-axis with >1 ranks by two_tier(), so a
+        # knob-forced ICI codec always engages its rings
+        ici_codec = self.codec_for(LINK_ICI, None)
+        ici_wire = self._wire_bytes(numel, itemsize, ici_codec)
+        # full precision keeps the byte-granularity shard estimate the
+        # launch-order pin certifies; a codec's payload is per-ELEMENT, so
+        # its estimate rides the element-granularity shard
+        dcn_wire = (
+            -(-numel * itemsize // ni) if resolved_dcn is None
+            else self._wire_bytes(-(-numel // ni), itemsize, resolved_dcn)
+        )
         return {
             "tier": "two_level",
             "bytes": nbytes,
             # rs + ag halves over intra: 2 * (ni-1)/ni of the flat
-            "ici_bytes": int(2 * nbytes * (ni - 1) // ni),
-            # the inter allreduce moves 2(ne-1)/ne of the 1/ni shard
-            "dcn_bytes": int(2 * shard * (ne - 1) // ne) if ne > 1 else 0,
+            "ici_bytes": int(2 * ici_wire * (ni - 1) // ni),
+            # the inter allreduce moves 2(ne-1)/ne of the 1/ni shard —
+            # compressed where the codec policy resolves one
+            "dcn_bytes": int(2 * dcn_wire * (ne - 1) // ne) if ne > 1 else 0,
+            "dcn_codec": resolved_dcn if ne > 1 else None,
+            "flat_codec": None,
         }
 
-    def bucket_launch_order(self, hierarchical: bool) -> List[int]:
+    def bucket_launch_order(self, hierarchical: bool,
+                            dcn_codec=None) -> List[int]:
         """Launch order for the overlap scheduler's per-bucket collectives.
         On a two-tier mesh with the hierarchical path active, buckets whose
         DCN stage dominates are streamed FIRST (descending cross-slice
-        bytes, stable) so the slow link is busy for the whole backward
-        window; everywhere else the plan's (readiness) order stands.
-        Results are still assembled in plan order — only the traced issue
-        order changes, so overlap-vs-serialized numerics are untouched."""
+        bytes — COMPRESSED wire bytes where a codec rides the tier, stable)
+        so the slow link is busy for the whole backward window; everywhere
+        else the plan's (readiness) order stands.  Results are still
+        assembled in plan order — only the traced issue order changes, so
+        overlap-vs-serialized numerics are untouched."""
         n = len(self.plan.buckets)
         if not (self.overlap and hierarchical and self.two_tier()):
             return list(range(n))
-        dcn = [self.bucket_tier_bytes(i, hierarchical)["dcn_bytes"]
+        dcn = [self.bucket_tier_bytes(i, hierarchical,
+                                      dcn_codec=dcn_codec)["dcn_bytes"]
                for i in range(n)]
         return sorted(range(n), key=lambda i: -dcn[i])
 
@@ -367,6 +537,14 @@ class Algorithm:
     #: boundaries (they call :func:`bagua_tpu.faults.inject.maybe_straggle`
     #: there themselves).
     straggler_gates_step: bool = True
+    #: Wire-codec defaults for the byte accounting AND the codec policy's
+    #: ``auto`` resolution (docs/compression.md): ``wire_codec_dcn`` names
+    #: the codec the family's hierarchical path rides on the cross-slice
+    #: DCN stage (ByteGrad/QAdam compress it natively), ``wire_codec_flat``
+    #: the codec its non-hierarchical bucket collective carries (ByteGrad's
+    #: compressed scatter-gather).  None = full precision.
+    wire_codec_dcn: Optional[str] = None
+    wire_codec_flat: Optional[str] = None
     #: Gradient-health sentinel contract: True when the family's POST-comm
     #: gradient representation is bitwise-identical on every rank (a plain
     #: summed/averaged bucket reduce), so the per-bucket ``isfinite``
@@ -454,7 +632,8 @@ class Algorithm:
         (DCN-dominant buckets first on hierarchical two-tier meshes under
         the overlap scheduler); results assemble in plan order."""
         flats = ctx.bucket_flats(grads)
-        order = ctx.bucket_launch_order(getattr(self, "hierarchical", False))
+        order = ctx.bucket_launch_order(getattr(self, "hierarchical", False),
+                                        dcn_codec=self.wire_codec_dcn)
         reduced: List = [None] * len(flats)
         for i in order:
             reduced[i] = self.reduce_bucket_grad(ctx, i, flats[i])

@@ -22,7 +22,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..communication import ReduceOp
+from ..communication import LINK_DCN, LINK_ICI, ReduceOp
 from ..compression import compressed_scatter_gather_allreduce
 from .base import Algorithm, AlgorithmContext
 
@@ -39,6 +39,8 @@ class QAdamAlgorithm(Algorithm):
     #: bucket flats under the resident layout and the compressed momentum
     #: pipeline consumes them with zero repacking
     supports_flat_resident = True
+    #: non-hierarchical compressed-phase wire format (byte accounting)
+    wire_codec_flat = "minmax_uint8"
 
     def __init__(
         self,
@@ -48,6 +50,7 @@ class QAdamAlgorithm(Algorithm):
         eps: float = 1e-8,
         weight_decay: float = 0.0,
         hierarchical: bool = True,
+        codec: str = "minmax_uint8",
     ):
         """
         Args:
@@ -57,14 +60,25 @@ class QAdamAlgorithm(Algorithm):
                 QAdamOptimizer q_adam.py:13-46).
             hierarchical: Enable hierarchical communication in the
                 compressed phase.
+            codec: Wire codec of the compressed DCN ring hops in the
+                hierarchical compressed phase (overridable by
+                ``BAGUA_COMPRESS_INTER``).
         """
+        from ..compression.codecs import get_codec
+
+        get_codec(codec)  # fail fast on a typo'd codec name
         self.warmup_steps = warmup_steps
         self.lr = lr
         self.betas = betas
         self.eps = eps
         self.weight_decay = weight_decay
         self.hierarchical = hierarchical
+        self.codec = codec
         self._compressed = False
+
+    @property
+    def wire_codec_dcn(self):
+        return self.codec
 
     def need_reset(self, step: int) -> bool:
         if step == self.warmup_steps and not self._compressed:
@@ -104,8 +118,24 @@ class QAdamAlgorithm(Algorithm):
 
     def _communicate_momentum(self, ctx: AlgorithmContext, exp_avg):
         flats = ctx.bucket_flats(exp_avg)
-        use_hier = (
+        # the true two-level decomposition where the mesh supports it:
+        # full-precision slice-local reduce-scatter (ICI is cheap), the
+        # COMPRESSED RING allreduce of the 1/intra momentum shard across
+        # slices (quantized ppermute hops, fp32 accumulation — the 1-bit
+        # Adam relaxation applied ON the slow link's hops), slice-local
+        # allgather.  Buckets are world-aligned (tensors_to_buckets), so
+        # both tiers divide evenly.
+        use_two_level = (
             self.hierarchical
+            and ctx.two_tier()
+            and ctx.internode.nranks() > 1
+        )
+        # legacy Leader form for hierarchical meshes the two-level gate
+        # refuses (an extra comm axis folded in): full-precision intra
+        # average, compressed scatter-gather across slices
+        use_hier = (
+            not use_two_level
+            and self.hierarchical
             and ctx.internode is not None
             and ctx.intranode is not None
             and ctx.internode.nranks() > 1
@@ -113,11 +143,29 @@ class QAdamAlgorithm(Algorithm):
         )
         out = []
         for f in flats:
-            if use_hier:
+            if use_two_level:
+                f = ctx.tier_reduce_scatter(f, ReduceOp.AVG)
+                f = ctx.tier_allreduce(f, ReduceOp.AVG, codec=self.codec)
+                f = ctx.tier_allgather(f)
+            elif use_hier:
                 f = ctx.intranode.allreduce(f, ReduceOp.AVG)
-                f = compressed_scatter_gather_allreduce(ctx.internode, f, average=True)
+                # the knob's `off` escape hatch holds on the legacy leg
+                # too: full-precision inter average (tier_allreduce, so
+                # the DCN chunk knob's ring schedule survives) instead
+                # of the codec
+                if ctx.codec_for(LINK_DCN, self.codec) is None:
+                    f = ctx.tier_allreduce(f, ReduceOp.AVG)
+                else:
+                    f = compressed_scatter_gather_allreduce(
+                        ctx.internode, f, average=True)
             elif ctx.comm.nranks() > 1:
-                f = compressed_scatter_gather_allreduce(ctx.comm, f, average=True)
+                if ctx.codec_for(LINK_ICI, self.codec) is None:
+                    # bucket_allreduce keeps the chunk knobs' ring
+                    # schedule on the full-precision escape hatch
+                    f = ctx.bucket_allreduce(f, ReduceOp.AVG, False)
+                else:
+                    f = compressed_scatter_gather_allreduce(
+                        ctx.comm, f, average=True)
             out.append(f)
         return ctx.from_bucket_flats(out, exp_avg)
 

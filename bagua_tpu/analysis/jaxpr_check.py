@@ -52,9 +52,21 @@ COLLECTIVE_PRIMS = {
 #: intra reduce-scatter, inter allreduce on the 1/intra shard, intra
 #: allgather — so the consistency checks (axis binding, cond agreement,
 #: overlap-vs-serialized multiset equality) cover the tiered collectives
-#: too.
+#: too.  ``:hier-<codec>`` (and ``:hier-compressed`` = the family's
+#: native/default codec) forces the COMPRESSED ring construction on the
+#: DCN tier (``compress_inter=<codec>``, ISSUE 15), so the sweep also
+#: certifies the quantized ppermute payloads — u8/int8/fp8 hop arrays and
+#: their f32 sidecars — emit identical multisets streamed vs serialized.
+#: ``bytegrad:hier`` IS the compressed construction since ISSUE 15 (its
+#: DCN tier rides the minmax ring natively, and ``hier-compressed``
+#: traces the identical program — the spelling stays supported for
+#: ad-hoc CLI runs but is not swept twice); the forced int8/fp8 configs
+#: cover the knob-forced path on the exact family.
 DEFAULT_FAMILIES = ("gradient_allreduce", "zero", "bytegrad",
-                    "gradient_allreduce:hier", "zero:hier", "bytegrad:hier")
+                    "gradient_allreduce:hier", "zero:hier", "bytegrad:hier",
+                    "gradient_allreduce:hier-int8",
+                    "gradient_allreduce:hier-fp8_e4m3",
+                    "gradient_allreduce:hier-fp8_e5m2")
 DEFAULT_ACCUM_STEPS = (1, 4)
 
 
@@ -239,22 +251,46 @@ def _bucket_accounting(trainer, collectives: Sequence[Collective]) -> List[dict]
         if padded % world == 0:
             sizes.add(padded // world)
         intra = getattr(trainer, "_intra", None)
-        if intra is not None and getattr(trainer, "_inter", None) is not None:
+        inter = getattr(trainer, "_inter", None)
+        if intra is not None and inter is not None:
             # hierarchical two-level payloads: the intra-padded flat (the
             # decomposition zero-pads buckets the intra world does not
             # divide) and its 1/intra shard (the DCN-stage operand)
             ni = intra.nranks()
+            ne = inter.nranks()
             p2 = -(-padded // ni) * ni
             sizes.update({p2, p2 // ni})
+            # compressed-ring hop payloads (ISSUE 15): the DCN ring's
+            # reduce-scatter hops carry 1/ne blocks of the shard (the
+            # allgather phase forwards the whole quantized shard per hop,
+            # already covered by p2 // ni above)
+            shard = p2 // ni
+            pe = -(-shard // ne) * ne
+            sizes.update({pe, pe // ne})
         return tuple(sorted(sizes))
 
     buckets = list(trainer._plan.buckets)
+    # the codecs' f32 sidecar arrays (mn/mx or scale, 1-2 scalars per
+    # hop) ride the same ppermute hops as their payload — shape (1,) in
+    # the reduce-scatter phase, 0-d in the allgather phase (the encoded
+    # chunk's parts are indexed down before forwarding).  Scalar psums
+    # (the loss reduction) are not ppermutes, so the prim filter keeps
+    # them out.  Sidecars are accounted at the trace level (every
+    # bucket's hops emit them identically) rather than attributed per
+    # bucket, where same-size collisions would be arbitrary.
+    sidecars = [
+        c for c in collectives
+        if c.prim == "ppermute" and c.dtype == "float32"
+        and int(np.prod(c.shape or (1,))) <= 2
+    ]
+    sidecar_set = set(id(c) for c in sidecars)
     # matches per size-group, then an even share per member bucket
     group_sizes = Counter(numels_of(b) for b in buckets)
     group_matches: Dict[Tuple[int, ...], List[Collective]] = {
         key: [
             c for c in collectives
             if int(np.prod(c.shape or (1,))) in key
+            and id(c) not in sidecar_set
         ]
         for key in group_sizes
     }
@@ -278,6 +314,14 @@ def _bucket_accounting(trainer, collectives: Sequence[Collective]) -> List[dict]
             ),
             "collectives": [c.render() for c in matched],
             "wire_bytes": int(sum(c.nbytes for c in matched)),
+        })
+    if sidecars:
+        rows.append({
+            "bucket": "codec_sidecars",
+            "padded_numel": 0,
+            "flat_bytes": 0,
+            "collectives": [c.render() for c in sidecars],
+            "wire_bytes": int(sum(c.nbytes for c in sidecars)),
         })
     return rows
 
@@ -365,14 +409,29 @@ def make_family_tracer(
     """``trace_fn(overlap_mode) -> (trainer, ClosedJaxpr)`` for one
     algorithm family's real step builder on the ambient (cpu-sim) mesh —
     or, for a ``family:hier`` spec, the hierarchical two-level construction
-    on a 2-slice x 4-chip ``('inter','intra')`` mesh."""
+    on a 2-slice x 4-chip ``('inter','intra')`` mesh.  ``family:hier-X``
+    additionally forces the DCN codec policy: ``X`` a codec name sets
+    ``compress_inter=X``; ``X = "compressed"`` keeps ``auto`` (the
+    family's own wire codec — ByteGrad's native compressed ring)."""
     import optax
 
     from ..core.backend import BaguaTrainer
 
     base_family, _, variant = family.partition(":")
-    hierarchical = variant == "hier"
-    if variant and not hierarchical:
+    hierarchical = variant.startswith("hier")
+    compress_inter = None
+    if hierarchical and variant != "hier":
+        suffix = variant[len("hier-"):] if variant.startswith("hier-") else ""
+        if suffix == "compressed":
+            compress_inter = "auto"  # the family's native wire codec
+        elif suffix:
+            from ..compression.codecs import get_codec
+
+            get_codec(suffix)  # fail fast on a typo'd spec
+            compress_inter = suffix
+        else:
+            raise ValueError(f"unknown family variant {family!r}")
+    elif variant and not hierarchical:
         raise ValueError(f"unknown family variant {family!r}")
 
     def build(overlap: str):
@@ -409,6 +468,7 @@ def make_family_tracer(
             accum_steps=accum_steps,
             overlap=overlap,
             autotune=False,
+            compress_inter=compress_inter,
         )
         state = trainer.init(params)
         return trainer, state, batch

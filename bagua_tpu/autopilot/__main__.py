@@ -94,6 +94,7 @@ def main(argv=None) -> int:
     ap.add_argument("--dcn-share", type=float, default=None)
     ap.add_argument("--hbm-horizon-s", type=float, default=None)
     ap.add_argument("--compress-family", default=None)
+    ap.add_argument("--compress-codec", default=None)
     ap.add_argument("--historian", action="store_true",
                     help="ingest the stream through a fresh telemetry "
                          "historian first (trend-augmented snapshots, as "
@@ -115,6 +116,7 @@ def main(argv=None) -> int:
         "ckpt_failures": args.ckpt_failures, "switch_family": args.family,
         "dcn_share": args.dcn_share, "hbm_horizon_s": args.hbm_horizon_s,
         "compress_family": args.compress_family,
+        "compress_codec": args.compress_codec,
     }
     config = replace(config, mode="observe",
                      **{k: v for k, v in overrides.items() if v is not None})

@@ -294,6 +294,11 @@ def default_engine_actuators(model_name: Optional[str] = None,
         }
         if action.kind in ("switch_family", "compress_dcn"):
             hint["family"] = action.target
+        if action.kind == "compress_dcn":
+            # the codec the service actuates onto recommended.compress_inter
+            # (every rank's next check-in re-jits the compressed DCN hops)
+            hint["codec"] = (action.evidence or {}).get(
+                "codec") or "minmax_uint8"
         return deliver_hints_via_service(model, [hint], addr=autotune_addr)
 
     def _quarantine(action: Action) -> bool:

@@ -115,6 +115,7 @@ class PolicyConfig:
     switch_family: str = "async"      # the ladder's switch rung target
     dcn_share: float = 0.5            # trend rule: DCN share of the step
     compress_family: str = "bytegrad"  # the compression hint's family
+    compress_codec: str = "minmax_uint8"  # DCN wire codec the hint actuates
     hbm_horizon_s: float = 600.0      # trend rule: pre-OOM projection
 
 
@@ -132,6 +133,7 @@ def config_from_env() -> PolicyConfig:
         switch_family=_env.get_autopilot_family(),
         dcn_share=_env.get_autopilot_dcn_share(),
         compress_family=_env.get_autopilot_compress_family(),
+        compress_codec=_env.get_autopilot_compress_codec(),
         hbm_horizon_s=_env.get_autopilot_hbm_horizon_s(),
     )
 
@@ -450,8 +452,11 @@ def decide(snapshot: dict, state: PolicyState, config: PolicyConfig,
                         f">= {config.dcn_share:.0%} of the step on the "
                         f"DCN tier (shares {shares}) sustained {streak} "
                         f"snapshots; hinting compression family "
-                        f"{config.compress_family!r} for the slow tier"),
-                evidence={"trends": dcn_items, "streak": streak},
+                        f"{config.compress_family!r} and actuating DCN "
+                        f"codec {config.compress_codec!r} for the slow "
+                        "tier"),
+                evidence={"trends": dcn_items, "streak": streak,
+                          "codec": config.compress_codec},
             ), now)
             state.streaks.pop("dcn", None)
 

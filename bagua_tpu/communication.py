@@ -240,6 +240,17 @@ class BaguaCommunicator:
     # exactly (rank r owns the r-th CONTIGUOUS slice), so ZeRO's
     # reduce-scatter → update → all-gather dance can swap primitives
     # without relayouting its optimizer-state chunks.
+    #
+    # ``codec=`` (ISSUE 15) fuses a compression codec INTO the hops: every
+    # reduce-scatter ``ppermute`` carries the quantized partial sum
+    # (payload + the codec's f32 sidecar), the receiver dequantizes and
+    # adds its own block in fp32 (the accumulation-dtype contract —
+    # quantization error enters per hop, never through the accumulator),
+    # and the allgather phase quantizes each rank's finished chunk exactly
+    # ONCE, forwarding the payload unchanged hop to hop.  Compressed bytes
+    # are what cross the wire — a 4x payload reduction for the u8/int8/fp8
+    # codecs minus the sidecar.  ``codec=None`` is byte-for-byte the
+    # pre-codec construction (HLO-pinned).
 
     def _ring_valid(self) -> bool:
         """Ring forms need a single nontrivial mesh axis to permute over."""
@@ -258,44 +269,74 @@ class BaguaCommunicator:
 
         return blocks, block
 
-    def _ring_reduce_scatter_1(self, x, op: ReduceOp):
+    def _ring_reduce_scatter_1(self, x, op: ReduceOp, codec=None):
         """One ring: rank r ends with the reduction of every rank's r-th
         block.  The partial sum for block b starts at rank ``(b+1) % n`` and
         travels +1 per hop, each rank adding its own contribution — n-1
         ``ppermute`` hops, each moving 1/n of the bytes (bandwidth-optimal,
-        like NCCL's ring)."""
+        like NCCL's ring).  With ``codec``: quantize-on-send (every hop
+        carries the codec payload + sidecar), dequantize and accumulate in
+        fp32 on receive — the compressed output stays f32."""
         n = self.nranks()
         if op not in (ReduceOp.SUM, ReduceOp.AVG):
             raise ValueError(f"ring reduce_scatter supports SUM/AVG, got {op}")
         r = self.rank()
         _, block = self._ring_blocks(x, n)
         perm = [(i, (i + 1) % n) for i in range(n)]
-        buf = block(r - 1)
-        # unrolled: every hop is its own ppermute instruction, so the
-        # scheduler may pipeline hop s+1's local add under hop s's wire time
+        if codec is None:
+            buf = block(r - 1)
+            # unrolled: every hop is its own ppermute instruction, so the
+            # scheduler may pipeline hop s+1's local add under hop s's wire
+            # time
+            for s in range(n - 1):
+                buf = self.ppermute(buf, perm)
+                buf = buf + block(r - 2 - s)
+            if op == ReduceOp.AVG:
+                buf = buf / n
+            return buf
+        buf = block(r - 1).astype(jnp.float32)
         for s in range(n - 1):
-            buf = self.ppermute(buf, perm)
-            buf = buf + block(r - 2 - s)
+            parts = codec.encode(buf[None])
+            parts = tuple(self.ppermute(p, perm) for p in parts)
+            buf = codec.decode(parts)[0] + block(r - 2 - s).astype(jnp.float32)
         if op == ReduceOp.AVG:
             buf = buf / n
         return buf
 
-    def _ring_allgather_1(self, x):
+    def _ring_allgather_1(self, x, codec=None):
         """One ring: input is this rank's block, output is all blocks in
         rank order (``[n * m, ...]``) — the inverse of
-        :meth:`_ring_reduce_scatter_1`'s ownership layout."""
+        :meth:`_ring_reduce_scatter_1`'s ownership layout.  With ``codec``:
+        this rank's block is quantized exactly ONCE; the hops forward the
+        payload unchanged (no re-quantization in the broadcast phase), and
+        the stacked parts decode in one chunked pass at the end."""
         n = self.nranks()
         r = self.rank()
         perm = [(i, (i + 1) % n) for i in range(n)]
-        out = jnp.zeros((n,) + x.shape, x.dtype)
-        out = lax.dynamic_update_slice_in_dim(out, x[None], r % n, axis=0)
-        buf = x
+        if codec is None:
+            out = jnp.zeros((n,) + x.shape, x.dtype)
+            out = lax.dynamic_update_slice_in_dim(out, x[None], r % n, axis=0)
+            buf = x
+            for s in range(n - 1):
+                buf = self.ppermute(buf, perm)
+                out = lax.dynamic_update_slice_in_dim(
+                    out, buf[None], (r - 1 - s) % n, axis=0
+                )
+            return out.reshape((n * x.shape[0],) + x.shape[1:])
+        cur = [p[0] for p in codec.encode(x[None])]
+        stacked = [jnp.zeros((n,) + c.shape, c.dtype) for c in cur]
+        stacked = [
+            lax.dynamic_update_slice_in_dim(o, c[None], r % n, axis=0)
+            for o, c in zip(stacked, cur)
+        ]
         for s in range(n - 1):
-            buf = self.ppermute(buf, perm)
-            out = lax.dynamic_update_slice_in_dim(
-                out, buf[None], (r - 1 - s) % n, axis=0
-            )
-        return out.reshape((n * x.shape[0],) + x.shape[1:])
+            cur = [self.ppermute(c, perm) for c in cur]
+            stacked = [
+                lax.dynamic_update_slice_in_dim(o, c[None], (r - 1 - s) % n,
+                                                axis=0)
+                for o, c in zip(stacked, cur)
+            ]
+        return codec.decode(tuple(stacked)).reshape(-1)
 
     def _ring_chunk_views(self, x, num_chunks: int, n: int):
         """Split flat ``x`` into ``num_chunks`` independent sub-buffers such
@@ -307,11 +348,26 @@ class BaguaCommunicator:
         view = x.reshape(n, num_chunks, m // num_chunks)
         return [view[:, j].reshape(-1) for j in range(num_chunks)]
 
+    @staticmethod
+    def _resolve_codec(codec):
+        """Lazy registry resolution (``compression`` imports this module,
+        so the codec registry cannot be a module-level import here)."""
+        if codec is None:
+            return None
+        from .compression.codecs import resolve_codec
+
+        return resolve_codec(codec)
+
     def ring_reduce_scatter(self, x, op: ReduceOp = ReduceOp.SUM,
-                            num_chunks: int = 1):
+                            num_chunks: int = 1, codec=None):
         """Chunked ring reduce-scatter of flat ``x`` (``size % nranks == 0``;
         ``num_chunks`` must divide the per-rank block).  Returns this rank's
-        contiguous slice — same layout as ``reduce_scatter(..., tiled)``."""
+        contiguous slice — same layout as ``reduce_scatter(..., tiled)``.
+        ``codec`` (a name or :class:`~bagua_tpu.compression.codecs.RingCodec`)
+        compresses every hop; the output is the fp32 accumulation cast back
+        to ``x.dtype``.  Ring-invalid communicators fall back to the fused
+        full-precision primitive (a 1-rank tier has no wire to compress)."""
+        codec = self._resolve_codec(codec)
         if not self._ring_valid():
             return self.reduce_scatter(x, op)
         n = self.nranks()
@@ -319,26 +375,34 @@ class BaguaCommunicator:
             parts = [x]
         else:
             parts = self._ring_chunk_views(x, num_chunks, n)
-        outs = [self._ring_reduce_scatter_1(p, op) for p in parts]
-        return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+        outs = [self._ring_reduce_scatter_1(p, op, codec) for p in parts]
+        out = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+        return out.astype(x.dtype) if codec is not None else out
 
-    def ring_allgather(self, x, num_chunks: int = 1):
+    def ring_allgather(self, x, num_chunks: int = 1, codec=None):
         """Chunked ring all-gather of this rank's flat chunk; inverse of
         :meth:`ring_reduce_scatter` (``[m] -> [nranks * m]`` in rank
-        order)."""
+        order).  ``codec`` quantizes this rank's chunk once and moves only
+        the payload+sidecar per hop (every receiver decodes the same
+        payload, so all ranks still agree bitwise on the result)."""
+        codec = self._resolve_codec(codec)
         if not self._ring_valid():
             return self.allgather(x, axis=0, tiled=True)
         n = self.nranks()
         if num_chunks <= 1:
-            return self._ring_allgather_1(x)
+            out = self._ring_allgather_1(x, codec)
+            return out.astype(x.dtype) if codec is not None else out
         mk = x.shape[0] // num_chunks
         subs = x.reshape(num_chunks, mk)
-        gathered = [self._ring_allgather_1(subs[j]) for j in range(num_chunks)]
+        gathered = [
+            self._ring_allgather_1(subs[j], codec) for j in range(num_chunks)
+        ]
         out = jnp.stack([g.reshape(n, mk) for g in gathered], axis=1)
-        return out.reshape(n * x.shape[0])
+        out = out.reshape(n * x.shape[0])
+        return out.astype(x.dtype) if codec is not None else out
 
     def ring_allreduce(self, x, op: ReduceOp = ReduceOp.AVG,
-                       num_chunks: int = 1):
+                       num_chunks: int = 1, codec=None):
         """Chunked double-buffered ring allreduce: reduce-scatter ring then
         all-gather ring per chunk.  Wire bytes equal the monolithic
         allreduce's ring model (``2(n-1)/n`` of the buffer); what changes is
@@ -346,7 +410,15 @@ class BaguaCommunicator:
         latency-hiding scheduler can interleave with compute and each
         other.  Buffers that don't split evenly are zero-padded internally
         (sound for SUM/AVG) and sliced back — unlike the scatter/gather
-        pair, whose ownership layout forbids silent padding."""
+        pair, whose ownership layout forbids silent padding.
+
+        ``codec`` makes compressed bytes what actually cross the wire: the
+        reduce-scatter hops carry quantized partial sums (dequantize +
+        fp32 accumulate per hop), the finished chunk — already divided for
+        AVG — is re-quantized exactly once, and the allgather hops forward
+        that payload unchanged.  ``codec=None`` is the exact pre-codec
+        construction (HLO-pinned by tests/test_compressed_ring.py)."""
+        codec = self._resolve_codec(codec)
         if not self._ring_valid():
             return self.allreduce(x, op)
         n = self.nranks()
@@ -355,11 +427,16 @@ class BaguaCommunicator:
         if pad:
             x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
         if num_chunks <= 1:
-            out = self._ring_allgather_1(self._ring_reduce_scatter_1(x, op))
+            out = self._ring_allgather_1(
+                self._ring_reduce_scatter_1(x, op, codec), codec
+            )
+            if codec is not None:
+                out = out.astype(x.dtype)
             return out[:size] if pad else out
         parts = self._ring_chunk_views(x, num_chunks, n)
         outs = [
-            self._ring_allgather_1(self._ring_reduce_scatter_1(p, op))
+            self._ring_allgather_1(self._ring_reduce_scatter_1(p, op, codec),
+                                   codec)
             for p in parts
         ]
         # each sub-result is [n, m/num_chunks] in rank order; re-interleave
@@ -367,6 +444,8 @@ class BaguaCommunicator:
         mk = parts[0].shape[0] // n
         out = jnp.stack([o.reshape(n, mk) for o in outs], axis=1)
         out = out.reshape(x.shape)
+        if codec is not None:
+            out = out.astype(x.dtype)
         return out[:size] if pad else out
 
     def broadcast(self, x, src: int = 0):

@@ -1,0 +1,245 @@
+"""Ring-hop codec registry — the wire formats of the compressed collectives.
+
+The Bagua paper's core relaxation is communication compression
+(arXiv 2107.01499; 1-bit Adam, arXiv 2102.02888).  Until ISSUE 15 the
+codecs ran as a *separate stage around* full-precision collectives; the
+compressed ring collectives (``BaguaCommunicator.ring_*(codec=)``) instead
+quantize ON the hop: every ``ppermute`` carries a codec payload plus its
+small f32 sidecar, the receiver dequantizes and accumulates in fp32, and
+the reduce-scatter result is re-quantized exactly once for the allgather
+phase.  This module owns the payload formats.
+
+Codec contract (all methods traced-safe):
+
+* ``encode(x2d)`` — ``[k, m]`` float input -> a tuple of arrays, small f32
+  sidecars first, the payload LAST, every part with leading dim ``k`` so
+  the parts of one chunk travel (and stack) together.
+* ``decode(parts)`` — exact inverse layout; returns ``[k, m]`` **float32**.
+  Dequantize-to-f32 is the accumulation-dtype contract: ring hops add
+  their local block in fp32, so quantization error never compounds through
+  the accumulator dtype, only through the per-hop re-quantization.
+* ``wire_bytes(numel)`` — host-side bytes one encoded chunk of ``numel``
+  elements puts on the wire (payload + sidecar); the byte-accounting
+  source for ``bucket_tier_bytes``, the launch spans, and the benches.
+
+Non-finite contract: a NaN/Inf element poisons (at least) its own decoded
+element and, for the scale-based codecs, its whole chunk — conservative on
+purpose, so the gradient-health sentinel still sees the poison after a
+compressed collective.
+
+Pallas fast path: the min/max **reduction** is where a fused kernel pays
+(BENCH_COMM r5: +8% at 1 MiB chunks, 7x at 8 MiB); purely elementwise maps
+(quantize against known bounds, every decompress, the fp8 cast) measured
+FASTER through the XLA lowering at every size, so only the reduction side
+gates on :data:`~bagua_tpu.compression.minmax_uint8._PALLAS_MIN_CHUNK_BYTES`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from .minmax_uint8 import (
+    _PALLAS_MIN_CHUNK_BYTES,
+    compress_chunked,
+    decompress_chunked,
+)
+
+
+def _pallas_ok(chunk_bytes: int, platform: Optional[str] = None) -> bool:
+    """The ONE gate for the fused Pallas reduction kernels: TPU, not
+    disabled, and the per-chunk payload past the measured crossover —
+    shared with :func:`..minmax_uint8._codec` so the crossover can never
+    be retuned in one place and not the other.  ``platform`` lets a
+    mesh-aware caller pass its comm mesh's platform; default is the
+    ambient backend."""
+    from .. import env
+
+    if chunk_bytes < _PALLAS_MIN_CHUNK_BYTES:
+        return False
+    if platform is None:
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:  # pragma: no cover - backend not initialized
+            return False
+    return platform == "tpu" and not env.is_pallas_codec_disabled()
+
+
+def _absmax_sidecar(x: jax.Array, chunk_bytes: int,
+                    fmax: float) -> Tuple[jax.Array, jax.Array]:
+    """Shared scaled-quantize front half of the int8/fp8 codecs: per-chunk
+    absmax (fused Pallas past the crossover) mapped onto a grid of
+    ``fmax``.  Returns ``(sidecar, safe)`` — ``safe`` is the
+    division-ready scale (1.0 for all-zero chunks), ``sidecar`` the wire
+    copy, which deliberately keeps a NaN absmax (a NaN fails every
+    comparison, so ``safe`` would silently become 1 and the cast would
+    flush the poison to a finite value — the sidecar NaN makes DECODE
+    propagate it, the grad-guard contract)."""
+    k, m = x.shape
+    if _pallas_ok(chunk_bytes):
+        from .pallas_codec import absmax_chunked_pallas
+
+        absmax = absmax_chunked_pallas(x.reshape(-1), k)
+    else:
+        absmax = jnp.abs(x).max(axis=1)
+    scale = absmax / fmax
+    safe = jnp.where(scale > 0, scale, 1.0)
+    return jnp.where(jnp.isnan(scale), scale, safe), safe
+
+
+class RingCodec:
+    """One wire format for the compressed ring hops."""
+
+    #: registry key (the user-facing knob value)
+    name: str = ""
+    #: dtype of the payload array (the bulk of the wire bytes)
+    payload_itemsize: int = 1
+    #: f32 sidecar scalars per encoded chunk
+    sidecar_floats: int = 0
+
+    def encode(self, x2d: jax.Array) -> Tuple[jax.Array, ...]:
+        raise NotImplementedError
+
+    def decode(self, parts: Tuple[jax.Array, ...]) -> jax.Array:
+        raise NotImplementedError
+
+    def wire_bytes(self, numel: int) -> int:
+        """Wire bytes of ONE encoded chunk of ``numel`` elements."""
+        return int(numel) * self.payload_itemsize + 4 * self.sidecar_floats
+
+    def __repr__(self) -> str:  # stable in logs / span attrs
+        return f"<RingCodec {self.name}>"
+
+
+class MinMaxUInt8Codec(RingCodec):
+    """The reference MinMaxUInt8 format: per-chunk ``[mn, mx]`` f32 sidecar
+    + u8 levels (``tests/internal/compressor.py`` golden math).  Fused
+    Pallas min/max+quantize past the measured chunk-size crossover."""
+
+    name = "minmax_uint8"
+    payload_itemsize = 1
+    sidecar_floats = 2
+
+    def encode(self, x2d):
+        k, m = x2d.shape
+        flat = x2d.reshape(-1)
+        if _pallas_ok(m * x2d.dtype.itemsize):
+            from .pallas_codec import compress_chunked_pallas
+
+            mn, mx, payload = compress_chunked_pallas(flat, k)
+        else:
+            mn, mx, payload = compress_chunked(flat, k)
+        return mn, mx, payload
+
+    def decode(self, parts):
+        mn, mx, payload = parts
+        return decompress_chunked(mn, mx, payload).reshape(payload.shape)
+
+
+class Int8Codec(RingCodec):
+    """Symmetric absmax int8: per-chunk f32 ``scale`` sidecar, payload
+    ``round(x / scale)`` clipped to [-127, 127].  One fewer sidecar float
+    than MinMaxUInt8 and a zero-centered grid (a zero gradient stays
+    exactly zero — MinMaxUInt8's grid need not contain 0).  The absmax
+    reduction takes the fused Pallas kernel past the crossover."""
+
+    name = "int8"
+    payload_itemsize = 1
+    sidecar_floats = 1
+
+    def encode(self, x2d):
+        x = x2d.astype(jnp.float32)
+        sidecar, safe = _absmax_sidecar(
+            x, x2d.shape[1] * x2d.dtype.itemsize, 127.0
+        )
+        q = jnp.clip(jnp.round(x / safe[:, None]), -127.0, 127.0)
+        return sidecar, q.astype(jnp.int8)
+
+    def decode(self, parts):
+        scale, payload = parts
+        return payload.astype(jnp.float32) * scale[:, None]
+
+
+class Fp8Codec(RingCodec):
+    """Scaled fp8: per-chunk f32 ``scale`` sidecar mapping the chunk's
+    absmax onto the format's max finite value, payload ``x / scale`` cast
+    to the fp8 dtype.  ``e4m3`` (3 mantissa bits, higher resolution) suits
+    gradient payloads; ``e5m2`` keeps bf16's exponent spread for
+    heavy-tailed chunks.  The scaling keeps denormal-range inputs
+    representable (the payload always spans the full fp8 range), and a
+    non-finite input propagates: ``inf/inf -> nan`` lands IN the payload.
+    The cast is elementwise, so the only reduction (absmax) gates on the
+    Pallas crossover like int8."""
+
+    payload_itemsize = 1
+    sidecar_floats = 1
+
+    def __init__(self, name: str, dtype):
+        self.name = name
+        self.dtype = dtype
+        self.fmax = float(jnp.finfo(dtype).max)
+
+    def encode(self, x2d):
+        x = x2d.astype(jnp.float32)
+        sidecar, safe = _absmax_sidecar(
+            x, x2d.shape[1] * x2d.dtype.itemsize, self.fmax
+        )
+        return sidecar, (x / safe[:, None]).astype(self.dtype)
+
+    def decode(self, parts):
+        scale, payload = parts
+        return payload.astype(jnp.float32) * scale[:, None]
+
+
+CODECS: Dict[str, RingCodec] = {
+    c.name: c
+    for c in (
+        MinMaxUInt8Codec(),
+        Int8Codec(),
+        Fp8Codec("fp8_e4m3", jnp.float8_e4m3fn),
+        Fp8Codec("fp8_e5m2", jnp.float8_e5m2),
+    )
+}
+
+#: codec-policy knob values beyond the codec names themselves:
+#: ``off`` forces full precision on the tier (even where the algorithm
+#: family compresses natively), ``auto`` defers to the family default —
+#: DCN compressed for the compression families (ByteGrad/QAdam), ICI
+#: full-precision for everyone (docs/compression.md).
+POLICY_OFF = "off"
+POLICY_AUTO = "auto"
+POLICY_VALUES = (POLICY_OFF, POLICY_AUTO) + tuple(sorted(CODECS))
+
+
+def get_codec(name: str) -> RingCodec:
+    codec = CODECS.get(name)
+    if codec is None:
+        raise ValueError(
+            f"unknown ring codec {name!r} (available: {sorted(CODECS)})"
+        )
+    return codec
+
+
+def resolve_codec(
+    codec: Union[None, str, RingCodec]
+) -> Optional[RingCodec]:
+    """None passes through (full precision); names resolve via the
+    registry; codec instances pass through."""
+    if codec is None:
+        return None
+    if isinstance(codec, RingCodec):
+        return codec
+    return get_codec(codec)
+
+
+def validate_codec_policy(value: str, knob: str) -> str:
+    """Normalize + validate one per-tier codec-policy knob value
+    (``BAGUA_COMPRESS_{INTRA,INTER}`` / the trainer kwargs)."""
+    v = (value or POLICY_AUTO).strip().lower()
+    if v not in POLICY_VALUES:
+        raise ValueError(
+            f"{knob} must be one of {'|'.join(POLICY_VALUES)}, got {value!r}"
+        )
+    return v

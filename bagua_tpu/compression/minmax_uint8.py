@@ -82,20 +82,38 @@ def _codec(comm: BaguaCommunicator):
     module docstring of :mod:`.pallas_codec` and ``BENCH_COMM.json``):
     Pallas compress on TPU for chunks ≥1 MiB, the XLA lowering otherwise
     and for every decompress.  ``BAGUA_DISABLE_PALLAS_CODEC=1`` forces the
-    jnp path for A/B checks."""
-    from .. import env
+    jnp path for A/B checks.  The gate itself is
+    :func:`.codecs._pallas_ok` — ONE place for the crossover, shared with
+    the ring codecs — fed this communicator's mesh platform."""
+    from .codecs import _pallas_ok
 
-    on_tpu = comm.mesh.devices.flat[0].platform == "tpu"
-    if on_tpu and not env.is_pallas_codec_disabled():
-        from .pallas_codec import compress_chunked_pallas
+    platform = comm.mesh.devices.flat[0].platform
 
-        def compress(v, n):
-            if (v.size // n) * v.dtype.itemsize >= _PALLAS_MIN_CHUNK_BYTES:
-                return compress_chunked_pallas(v, n)
-            return compress_chunked(v, n)
+    def compress(v, n):
+        if _pallas_ok((v.size // n) * v.dtype.itemsize, platform):
+            from .pallas_codec import compress_chunked_pallas
 
-        return compress, decompress_chunked
-    return compress_chunked, decompress_chunked
+            return compress_chunked_pallas(v, n)
+        return compress_chunked(v, n)
+
+    return compress, decompress_chunked
+
+
+def quantize_with_bounds(
+    x2d: jax.Array, mn: jax.Array, mx: jax.Array
+) -> jax.Array:
+    """Quantize ``[k, m]`` chunks against GIVEN per-chunk bounds — the
+    codec's quantize half without its min/max reduction pass.  Values
+    outside the bounds clamp to the grid edge (same clip the full codec
+    applies), so sound bounds cost at most one extra grid step of error."""
+    scale = LEVELS / (mx - mn + EPS)
+    upper = jnp.round(mx * scale)
+    lower = upper - LEVELS
+    level = jnp.clip(
+        jnp.round(x2d.astype(jnp.float32) * scale[:, None]),
+        lower[:, None], upper[:, None],
+    )
+    return (level - lower[:, None]).astype(jnp.uint8)
 
 
 def compressed_scatter_gather_allreduce(
@@ -106,8 +124,21 @@ def compressed_scatter_gather_allreduce(
 
     Pipeline (mirrors centralized_low_precision_synchronous.rs:31-70):
     compress all nranks chunks → all_to_all → decompress → reduce own chunk →
-    compress own chunk → all_gather → decompress.  ``x`` must be flat with
+    quantize own chunk → all_gather → decompress.  ``x`` must be flat with
     ``size % nranks == 0`` (the bucket layer pads with world-size alignment).
+
+    The allgather leg REUSES the scatter leg's scales (ISSUE 15): the
+    reduced chunk provably lies within the mean/sum of its sources'
+    ``[mn, mx]`` bounds (each dequantized source is clamped to its own
+    grid), so the second quantize runs against those derived bounds —
+    ONE min/max reduction pass per bucket instead of two, measurable on
+    large buckets where the reduction is the codec's memory-bound half
+    (BENCH_COMM r5).  Bound slack: a dequantized source can overshoot its
+    bound by half a source grid step (``upper = round(mx·scale)``), and
+    the derived grid is at most the mean source range wide — the clamp
+    below absorbs both, keeping the error within one grid step of the
+    recompute-min/max form.  Bits differ from that form, so the loss
+    goldens carry regeneration provenance (tests/test_loss_goldens.py).
     """
     n = comm.nranks()
     compress, decompress = _codec(comm)
@@ -118,8 +149,11 @@ def compressed_scatter_gather_allreduce(
     mx_t = comm.alltoall(mx, split_axis=0, concat_axis=0)
     vals = decompress(mn_t, mx_t, payload_t).reshape(n, -1)
     red = vals.mean(axis=0) if average else vals.sum(axis=0)
-    # compress own reduced chunk and share it with everyone
-    mn2, mx2, payload2 = compress(red, 1)
+    # quantize own reduced chunk against the sources' combined bounds (no
+    # second min/max pass) and share it with everyone
+    mn2 = (jnp.mean(mn_t) if average else jnp.sum(mn_t)).reshape(1)
+    mx2 = (jnp.mean(mx_t) if average else jnp.sum(mx_t)).reshape(1)
+    payload2 = quantize_with_bounds(red.reshape(1, -1), mn2, mx2)
     payload_all = comm.allgather(payload2, axis=0, tiled=True)  # [n, chunk]
     mn_all = comm.allgather(mn2, axis=0, tiled=True)            # [n]
     mx_all = comm.allgather(mx2, axis=0, tiled=True)

@@ -232,6 +232,100 @@ def compress_chunked_pallas(
     return stats[:, 0, 0], stats[:, 1, 0], payload
 
 
+def _absmax_kernel(x_ref, stats_ref, *, chunk: int):
+    """Fused per-chunk absmax (the int8/fp8 codecs' only reduction).  Same
+    stats-block layout as the min/max kernels: row 0 carries the value."""
+    x = x_ref[:].astype(jnp.float32)
+    rows, lanes = x.shape
+    flat_idx = (
+        jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) * lanes
+        + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    )
+    mask = flat_idx < chunk
+    am = jnp.max(jnp.where(mask, jnp.abs(x), -jnp.inf))
+    stats_ref[:] = jnp.full((_STATS_ROWS, _LANE), am, jnp.float32)
+
+
+def _absmax_tile_kernel(x_ref, stats_ref, *, chunk: int):
+    """Tiled absmax accumulation past the fused VMEM ceiling (the
+    ``_minmax_tile_kernel`` pattern: the stats block maps to the same
+    chunk-indexed output for every tile step, so it accumulates in VMEM)."""
+    j = pl.program_id(1)
+    x = x_ref[:].astype(jnp.float32)
+    rows, lanes = x.shape
+    base = j * rows * lanes
+    flat_idx = (
+        base
+        + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) * lanes
+        + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    )
+    mask = flat_idx < chunk
+    am = jnp.max(jnp.where(mask, jnp.abs(x), -jnp.inf))
+    tile_stats = jnp.full((_STATS_ROWS, _LANE), am, jnp.float32)
+
+    @pl.when(j == 0)
+    def _init():
+        stats_ref[:] = tile_stats
+
+    @pl.when(j > 0)
+    def _accum():
+        stats_ref[:] = jnp.maximum(stats_ref[:], tile_stats)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def absmax_chunked_pallas(
+    x: jax.Array, n_chunks: int, interpret: bool = False
+) -> jax.Array:
+    """Per-chunk absmax of flat ``x`` (``size % n_chunks == 0``) — the
+    reduction half of the int8/fp8 ring codecs.  The elementwise quantize/
+    cast that follows stays on the XLA lowering (measured faster than
+    Pallas for pure maps at every size, see the module docstring)."""
+    assert x.size % n_chunks == 0, (x.size, n_chunks)
+    chunk = x.size // n_chunks
+    rows = _padded_rows(chunk)
+    tiled = rows > _MAX_FUSED_ROWS
+    if tiled:
+        rows = -(-rows // _TILE_ROWS) * _TILE_ROWS
+    padded = rows * _LANE
+    xp = jnp.pad(
+        x.reshape(n_chunks, chunk).astype(jnp.float32),
+        ((0, 0), (0, padded - chunk)),
+    ).reshape(n_chunks * rows, _LANE)
+    if not tiled:
+        stats = pl.pallas_call(
+            functools.partial(_absmax_kernel, chunk=chunk),
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((_STATS_ROWS, _LANE), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(
+                (n_chunks * _STATS_ROWS, _LANE), jnp.float32
+            ),
+            interpret=interpret,
+        )(xp)
+    else:
+        n_tiles = rows // _TILE_ROWS
+        stats = pl.pallas_call(
+            functools.partial(_absmax_tile_kernel, chunk=chunk),
+            grid=(n_chunks, n_tiles),
+            in_specs=[
+                pl.BlockSpec((_TILE_ROWS, _LANE),
+                             lambda i, j: (i * n_tiles + j, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((_STATS_ROWS, _LANE), lambda i, j: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(
+                (n_chunks * _STATS_ROWS, _LANE), jnp.float32
+            ),
+            interpret=interpret,
+        )(xp)
+    return stats.reshape(n_chunks, _STATS_ROWS, _LANE)[:, 0, 0]
+
+
 @functools.partial(jax.jit, static_argnums=(3,))
 def decompress_chunked_pallas(
     mn: jax.Array, mx: jax.Array, payload: jax.Array, interpret: bool = False

@@ -173,6 +173,8 @@ class BaguaTrainer:
         overlap_chunk_bytes: Optional[int] = None,
         overlap_chunk_bytes_intra: Optional[int] = None,
         overlap_chunk_bytes_inter: Optional[int] = None,
+        compress_intra: Optional[str] = None,
+        compress_inter: Optional[str] = None,
         flat_resident: Optional[str] = None,
         grad_guard: Optional[str] = None,
         grad_guard_budget: int = 3,
@@ -259,6 +261,24 @@ class BaguaTrainer:
         env ``BAGUA_OVERLAP_CHUNK_BYTES_INTRA`` / ``..._INTER``: fall back
         to ``overlap_chunk_bytes`` for that tier.  Setting either is, like
         the link-agnostic knob, an explicit opt-in to the ring path.
+
+        ``compress_intra`` / ``compress_inter``: the per-link-class codec
+        policy (docs/compression.md) — what the ring hops of each
+        bandwidth tier carry on the wire.  ``auto`` (default, or env
+        ``BAGUA_COMPRESS_INTRA`` / ``BAGUA_COMPRESS_INTER``) defers to
+        the algorithm family: ByteGrad/QAdam compress the cross-slice DCN
+        stage natively (quantized ppermute hops, fp32 accumulation) and
+        everything else stays full precision — the Bagua relaxation
+        applied only where bytes are expensive.  ``off`` forces full
+        precision on the tier (even for the compression families); a
+        codec name (``minmax_uint8``/``int8``/``fp8_e4m3``/``fp8_e5m2``)
+        forces that codec for every family riding the tier — an explicit
+        opt-in to lossy gradient communication for exact families.
+        Unlike the chunk knobs these apply to the serialized path too
+        (compression is a wire format, not a schedule), and both ride the
+        step-cache key, ``BaguaHyperparameter``, and the autotune
+        recommendation path (the autopilot's ``compress_dcn`` trend hint
+        actuates ``compress_inter`` through it).
 
         ``flat_resident``: the flat-resident training-state layout
         (docs/flat_layout.md).  ``"on"``: params, gradients, and optimizer
@@ -399,6 +419,16 @@ class BaguaTrainer:
         self.overlap_chunk_bytes_inter = int(
             env.get_overlap_chunk_bytes_inter()
             if overlap_chunk_bytes_inter is None else overlap_chunk_bytes_inter
+        )
+        from ..compression.codecs import validate_codec_policy
+
+        self.compress_intra = validate_codec_policy(
+            env.get_compress_intra() if compress_intra is None
+            else compress_intra, "compress_intra"
+        )
+        self.compress_inter = validate_codec_policy(
+            env.get_compress_inter() if compress_inter is None
+            else compress_inter, "compress_inter"
         )
         self.flat_resident = (
             flat_resident or env.get_flat_resident_mode()
@@ -596,6 +626,11 @@ class BaguaTrainer:
             inter_chunk_bytes=(
                 self.overlap_chunk_bytes_inter or None if overlap else None
             ),
+            # the codec policy applies to the serialized path too —
+            # compression is a wire format, not a schedule (the knobs are
+            # normalized, so "auto" reaches codec_for unchanged)
+            intra_codec=self.compress_intra,
+            inter_codec=self.compress_inter,
             flat_resident=self._flat_resident,
         )
 
@@ -1369,15 +1404,25 @@ class BaguaTrainer:
                     # ICI vs DCN.  Results assemble in plan order — issue
                     # order never changes the numerics.
                     hier = getattr(algo, "hierarchical", False)
-                    order = ctx.bucket_launch_order(hier)
+                    order = ctx.bucket_launch_order(
+                        hier, dcn_codec=algo.wire_codec_dcn
+                    )
                     reduced = [None] * len(grads["flats"])
                     for i in order:
-                        tiers = ctx.bucket_tier_bytes(i, hier)
+                        # tier estimates report COMPRESSED wire bytes when
+                        # a codec rides the tier, so the spans (and
+                        # obs/device_comm_dcn_s attribution downstream)
+                        # describe what actually crosses the wire
+                        tiers = ctx.bucket_tier_bytes(
+                            i, hier, dcn_codec=algo.wire_codec_dcn,
+                            flat_codec=algo.wire_codec_flat,
+                        )
                         with trace_span(
                             "trace/bucket_collective", bucket=i,
                             bytes=tiers["bytes"], tier=tiers["tier"],
                             ici_bytes=tiers["ici_bytes"],
                             dcn_bytes=tiers["dcn_bytes"],
+                            dcn_codec=tiers["dcn_codec"],
                         ):
                             reduced[i] = algo.reduce_bucket_grad(
                                 ctx, i, grads["flats"][i]
@@ -1585,6 +1630,11 @@ class BaguaTrainer:
             self.overlap_chunk_bytes if overlap else 0,
             self.overlap_chunk_bytes_intra if overlap else 0,
             self.overlap_chunk_bytes_inter if overlap else 0,
+            # the codec policy changes the traced program in BOTH overlap
+            # and serialized constructions (compressed ring hops replace
+            # fused collectives), so the raw knob values always key
+            self.compress_intra,
+            self.compress_inter,
             # grad guard: "warn" and "abort" trace the same program (the
             # policy difference is host-side), "skip" adds the rewind
             # selects; armed traced faults compile into the step, so their
@@ -2419,6 +2469,20 @@ class BaguaTrainer:
             self.overlap_chunk_bytes_inter = int(
                 recommended.overlap_chunk_bytes_inter
             )
+        # codec policy rides the same path ("" = keep current): the
+        # autopilot's compress_dcn trend hint actuates compress_inter here
+        # — every rank applies it at its next check-in (the service's
+        # per-train_iter decision cache keeps it SPMD-uniform) and the
+        # step-cache key re-jits the compressed construction
+        from ..compression.codecs import validate_codec_policy
+
+        for attr in ("compress_intra", "compress_inter"):
+            value = getattr(recommended, attr, "")
+            if value:
+                try:
+                    setattr(self, attr, validate_codec_policy(value, attr))
+                except ValueError as e:
+                    logger.warning("autotune recommendation ignored: %s", e)
         if recommended.buckets:
             named_by_name = {p.name: p for p in self._named_params}
             decl_buckets = [
@@ -2732,6 +2796,8 @@ class BaguaTrainer:
             overlap_chunk_bytes=int(self.overlap_chunk_bytes),
             overlap_chunk_bytes_intra=int(self.overlap_chunk_bytes_intra),
             overlap_chunk_bytes_inter=int(self.overlap_chunk_bytes_inter),
+            compress_intra=self.compress_intra,
+            compress_inter=self.compress_inter,
         )
 
     def _batch_spec(self) -> P:

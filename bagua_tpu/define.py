@@ -72,6 +72,14 @@ class BaguaHyperparameter(BaseModel):
     #: bytes (0 = keep current / fall back to ``overlap_chunk_bytes``)
     overlap_chunk_bytes_intra: int = 0
     overlap_chunk_bytes_inter: int = 0
+    #: per-link-class codec policy (docs/compression.md): what the ring
+    #: hops of each bandwidth tier carry on the wire — ``off``/``auto``/a
+    #: codec name ("" = keep current).  ``compress_inter`` is the knob the
+    #: autopilot's ``compress_dcn`` trend hint actuates through the
+    #: recommendation path (compress the slow link when DCN seconds
+    #: dominate the step)
+    compress_intra: str = ""
+    compress_inter: str = ""
 
     def update(self, param_dict: dict) -> "BaguaHyperparameter":
         tmp = self.model_dump()

@@ -69,6 +69,23 @@ _declare("BAGUA_OVERLAP_CHUNK_BYTES_INTER", "int", "0",
          "hierarchical two-level collectives — size it larger than the ICI "
          "target (a chunk that amortizes an ICI hop is far too small for a "
          "DCN hop); 0 falls back to BAGUA_OVERLAP_CHUNK_BYTES.")
+_declare("BAGUA_COMPRESS_INTRA", "str", "auto",
+         "Per-link codec policy for the slice-local ICI tier (and the flat "
+         "single-axis ring): `auto` (default) keeps ICI full-precision — "
+         "slice-local bytes are cheap; `off` forces full precision; a "
+         "codec name (minmax_uint8|int8|fp8_e4m3|fp8_e5m2) makes the flat/"
+         "intra ring hops carry that codec's payload — an explicit opt-in "
+         "to lossy gradient communication.  See docs/compression.md.")
+_declare("BAGUA_COMPRESS_INTER", "str", "auto",
+         "Per-link codec policy for the cross-slice DCN tier of the "
+         "hierarchical two-level collectives: `auto` (default) defers to "
+         "the algorithm family — ByteGrad/QAdam compress the DCN stage "
+         "natively (quantized ring hops, fp32 accumulation), exact "
+         "families stay full precision; `off` forces full precision even "
+         "for the compression families; a codec name "
+         "(minmax_uint8|int8|fp8_e4m3|fp8_e5m2) compresses the DCN hops "
+         "for EVERY family.  The autopilot's compress_dcn trend hint "
+         "actuates this knob through the autotune recommendation path.")
 _declare("BAGUA_FLAT_RESIDENT", "enum", "auto",
          "Flat-resident training state: keep params/grads/optimizer state "
          "as bucket-flat buffers across steps (`on`), keep the leaf pytree "
@@ -375,6 +392,13 @@ _declare("BAGUA_AUTOPILOT_COMPRESS_FAMILY", "str", "bytegrad",
          "(its hierarchical path compresses only the cross-slice DCN "
          "stage; delivered as an autotune perf hint, never a forced "
          "switch).")
+_declare("BAGUA_AUTOPILOT_COMPRESS_CODEC", "str", "minmax_uint8",
+         "DCN wire codec the autopilot's compress_dcn hint ACTUATES: the "
+         "autotune service applies it to the recommended "
+         "`compress_inter` policy, so every rank's next check-in re-jits "
+         "its hierarchical collectives with compressed cross-slice ring "
+         "hops (minmax_uint8|int8|fp8_e4m3|fp8_e5m2; "
+         "docs/compression.md).")
 _declare("BAGUA_AUTOPILOT_HBM_HORIZON_S", "float", "600",
          "Pre-OOM horizon for the autopilot's HBM trend rule: when a "
          "rank's historian headroom slope (obs/hbm_headroom_slope) is "
@@ -573,6 +597,19 @@ def get_overlap_chunk_bytes_inter() -> int:
     hierarchical two-level collectives; 0 (default) falls back to
     :func:`get_overlap_chunk_bytes`."""
     return env_int("BAGUA_OVERLAP_CHUNK_BYTES_INTER")
+
+
+def get_compress_intra() -> str:
+    """Per-link codec policy for the ICI tier / flat single-axis ring
+    (``auto`` default — full precision; validation lives in
+    :func:`bagua_tpu.compression.codecs.validate_codec_policy`)."""
+    return env_str("BAGUA_COMPRESS_INTRA")
+
+
+def get_compress_inter() -> str:
+    """Per-link codec policy for the cross-slice DCN tier (``auto``
+    default — defer to the algorithm family's wire codec)."""
+    return env_str("BAGUA_COMPRESS_INTER")
 
 
 def get_flat_resident_mode() -> str:
@@ -875,6 +912,11 @@ def get_autopilot_dcn_share() -> float:
 def get_autopilot_compress_family() -> str:
     """Compression family the DCN-dominance hint names."""
     return env_str("BAGUA_AUTOPILOT_COMPRESS_FAMILY")
+
+
+def get_autopilot_compress_codec() -> str:
+    """DCN wire codec the compress_dcn hint actuates through autotune."""
+    return env_str("BAGUA_AUTOPILOT_COMPRESS_CODEC")
 
 
 def get_autopilot_hbm_horizon_s() -> float:

@@ -160,20 +160,39 @@ class AutotuneService:
           re-jit plus a queued state migration, never a restart), and the
           per-train_iter decision cache keeps the switch SPMD-uniform.
         * ``autopilot_compress_dcn`` — the DCN-dominance trend hint:
-          re-grant the once-per-point re-measure (the dominance evidence
-          taints the current window's score) and log the suggested
-          compression family.  A hint, never a pin — the BO loop keeps
-          the last word on whether compressing the slow tier actually
-          wins on this workload.
+          ACTUATE the wire-byte reduction by setting the recommended
+          ``compress_inter`` codec policy — every rank applies it at its
+          next check-in through the normal recommendation path (a re-jit
+          with compressed cross-slice ring hops, kept SPMD-uniform by the
+          per-train_iter decision cache) — and re-grant the once-per-point
+          re-measure (the dominance evidence taints the current window's
+          score).  The FAMILY named by the hint stays a suggestion (the
+          BO loop keeps the last word on a family switch), but the codec
+          flip is live: hierarchical collectives of the current family
+          start carrying compressed DCN bytes without one.
         """
         kind = hint.get("kind")
         if kind == "autopilot_compress_dcn":
+            from ..compression.codecs import validate_codec_policy
+
             task.sample_retried = False
-            logger.info(
-                "autotune[%s]: autopilot reports sustained DCN dominance; "
-                "suggested compression family %r (re-measure re-granted)",
-                task.model_name, hint.get("family"),
-            )
+            codec = str(hint.get("codec") or "minmax_uint8")
+            try:
+                task.recommended.compress_inter = validate_codec_policy(
+                    codec, "compress_inter"
+                )
+            except ValueError as e:
+                logger.warning("autotune[%s]: compress_dcn hint carried an "
+                               "unknown codec, NOT actuated (re-measure "
+                               "still re-granted): %s",
+                               task.model_name, e)
+            else:
+                logger.info(
+                    "autotune[%s]: autopilot reports sustained DCN "
+                    "dominance; actuating DCN codec %r (suggested "
+                    "compression family %r, re-measure re-granted)",
+                    task.model_name, codec, hint.get("family"),
+                )
         elif kind == "autopilot_retune":
             task.sample_retried = False
             if task.completed and task.extra_samples < 16:

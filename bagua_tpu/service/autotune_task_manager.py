@@ -147,6 +147,15 @@ class AutotuneTaskManager:
             overlap_chunk_bytes_inter=(
                 last_hp.overlap_chunk_bytes_inter if last_hp is not None else 0
             ),
+            # the codec policy is carried through like the overlap knobs —
+            # the autopilot's actuated compress_inter must survive every
+            # later re-bucketing recommendation
+            compress_intra=(
+                last_hp.compress_intra if last_hp is not None else ""
+            ),
+            compress_inter=(
+                last_hp.compress_inter if last_hp is not None else ""
+            ),
         )
 
     def best_hyperparameters(

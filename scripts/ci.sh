@@ -17,9 +17,12 @@ echo "=== bagua-lint (AST rules + jaxpr collective consistency) ==="
 # overlap-vs-serialized collective-multiset equality for the algorithm
 # families at accum_steps 1 and 4 — including the hierarchical two-level
 # configs (family:hier on a 2-slice x 4-chip mesh: intra reduce-scatter,
-# inter allreduce on the 1/intra shard, intra allgather; ISSUE 11).  The
-# historical torch-import gate is now the `torch-import` rule.  See
-# docs/analysis.md and docs/hierarchical.md.
+# inter allreduce on the 1/intra shard, intra allgather; ISSUE 11) and
+# the compressed-ring configs (bytegrad:hier-compressed + forced
+# int8/fp8 DCN codecs: quantized ppermute payloads with their f32
+# sidecars must emit identical multisets streamed vs serialized;
+# ISSUE 15).  The historical torch-import gate is now the `torch-import`
+# rule.  See docs/analysis.md, docs/hierarchical.md, docs/compression.md.
 JAX_PLATFORMS=cpu \
 python -m bagua_tpu.analysis bagua_tpu/ --baseline .bagua-lint-baseline.json
 

@@ -74,6 +74,7 @@ MODULES = [
     "bagua_tpu.ops.flash_attention",
     "bagua_tpu.ops.gmm",
     "bagua_tpu.ops.tiles",
+    "bagua_tpu.compression.codecs",
     "bagua_tpu.compression.minmax_uint8",
     "bagua_tpu.compression.pallas_codec",
     "bagua_tpu.contrib.fused_optimizer",

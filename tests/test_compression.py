@@ -43,8 +43,22 @@ def test_codec_matches_numpy_golden_single_chunk():
     np.testing.assert_allclose(np.asarray(y), gy, rtol=1e-6)
 
 
+def _numpy_quantize_with_bounds(x, mn, mx):
+    """The golden codec's quantize half against GIVEN bounds (mirrors
+    bagua_tpu.compression.minmax_uint8.quantize_with_bounds)."""
+    eps, levels = 1e-7, 255.0
+    scale = levels / (mx - mn + eps)
+    upper = np.round(mx * scale)
+    lower = upper - levels
+    level = np.clip(np.round(x.astype(np.float32) * scale), lower, upper)
+    return (mn, mx), (level - lower).astype(np.uint8)
+
+
 def _numpy_scatter_gather(xs: np.ndarray, average=True) -> np.ndarray:
-    """Simulate the full pipeline rank by rank in numpy."""
+    """Simulate the full pipeline rank by rank in numpy — including the
+    allgather leg's scale REUSE: the reduced chunk is quantized against the
+    mean/sum of its sources' bounds instead of a recomputed min/max (one
+    reduction pass per bucket, ISSUE 15)."""
     golden = MinMaxUInt8Numpy()
     n, size = xs.shape
     chunk = size // n
@@ -58,10 +72,16 @@ def _numpy_scatter_gather(xs: np.ndarray, average=True) -> np.ndarray:
     for r in range(n):
         vals = np.stack([golden.decompress(*comp[(src, r)]) for src in range(n)])
         reduced[r] = vals.mean(0) if average else vals.sum(0)
-    # stage 3: compress own chunk, allgather, decompress
+    # stage 3: quantize own chunk against the sources' combined bounds,
+    # allgather, decompress
     out = np.zeros(size, np.float32)
+    agg = np.mean if average else np.sum
     for c in range(n):
-        mmx, payload = golden.compress(reduced[c])
+        mns = np.array([comp[(src, c)][0][0] for src in range(n)])
+        mxs = np.array([comp[(src, c)][0][1] for src in range(n)])
+        mmx, payload = _numpy_quantize_with_bounds(
+            reduced[c], np.float32(agg(mns)), np.float32(agg(mxs))
+        )
         out[c * chunk : (c + 1) * chunk] = golden.decompress(mmx, payload)
     return out
 
@@ -75,8 +95,10 @@ def test_compressed_scatter_gather_matches_numpy_sim(average):
     comm = get_backend("").global_communicator
     from jax.sharding import PartitionSpec as P
 
+    from bagua_tpu.compat import shard_map
+
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: compressed_scatter_gather_allreduce(comm, x[0], average=average)[None],
             mesh=comm.mesh,
             in_specs=P(comm.axis_name),
