@@ -158,6 +158,14 @@ class _Server(socketserver.ThreadingTCPServer):
     # bind_and_activate=True would be a no-op)
     allow_reuse_address = True
     daemon_threads = True
+    # socketserver's default listen backlog is 5.  A pod-scale cold start
+    # is a connect STORM — every launcher plus every heartbeat thread
+    # dials the one restart store within the same join window — and with
+    # a 5-deep accept queue the kernel drops the overflow SYNs, which
+    # clients only recover from after a ≥1 s retransmit.  That turns an
+    # O(ms) rendezvous into O(seconds) at 128+ connections (measured by
+    # scripts/scale_drill.py, before/after in BENCH_SCALE.json).
+    request_queue_size = 256
 
 
 class TCPStoreServer:

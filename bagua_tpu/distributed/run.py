@@ -326,14 +326,33 @@ def _connect_restart_store(args, timeout_s: float = 60.0):
 
     deadline = time.time() + timeout_s
     delay = 0.1
+    attempts = 0
     while True:
         try:
-            return TCPStore(args.master_addr, args.restart_coordinator_port,
-                            timeout_s=timeout_s)
-        except OSError:
+            client = TCPStore(args.master_addr,
+                              args.restart_coordinator_port,
+                              timeout_s=timeout_s)
+            if attempts:
+                logger.info(
+                    "restart store %s:%d reachable after %d retry(ies)",
+                    args.master_addr, args.restart_coordinator_port,
+                    attempts,
+                )
+            return client
+        except OSError as e:
+            attempts += 1
             remaining = deadline - time.time()
             if remaining <= 0:
-                raise
+                # surface the whole story, not just the LAST socket error:
+                # how long we tried and how often, with the final failure
+                # chained as __cause__ (ECONNREFUSED = server never came
+                # up; EHOSTUNREACH = wrong --master-addr; ...)
+                raise ConnectionError(
+                    f"restart store {args.master_addr}:"
+                    f"{args.restart_coordinator_port} unreachable after "
+                    f"{attempts} attempt(s) over {timeout_s:.0f}s "
+                    f"(last error: {type(e).__name__}: {e})"
+                ) from e
             time.sleep(min(delay * (0.5 + random.random()), remaining))
             delay = min(delay * 2, 5.0)
 

@@ -118,8 +118,8 @@ _declare("BAGUA_FAULT_PLAN", "str", "",
          "kind, step/op trigger, count, seed) armed at process start — "
          "drills and chaos tests only, never production.  Points: "
          "store.op, elastic.heartbeat, ckpt.write, ckpt.sidecar, "
-         "collective.hang, grad.poison, step.straggle, async.partition.  "
-         "See bagua_tpu.faults.inject.")
+         "collective.hang, grad.poison, step.straggle, async.partition, "
+         "podsim.link.  See bagua_tpu.faults.inject.")
 _declare("BAGUA_ASYNC_MAX_STALENESS", "int", "4",
          "Bounded-staleness cap for async model averaging: when any rank's "
          "applied-round counter reaches this many rounds behind the "
@@ -415,6 +415,23 @@ _declare("BAGUA_CKPT_QUARANTINED_PATHS", "str", "",
          "the verified pre-quarantine history.  Injected by the elastic "
          "launcher at restart boundaries when the autopilot (in act mode) "
          "quarantined a path; operators can set it by hand.")
+# -- pod-scale drill (docs/podsim.md) --
+_declare("BAGUA_SCALE_RANKS", "str", "32,64,128",
+         "Comma-separated world sizes scripts/scale_drill.py sweeps: the "
+         "first (largest-affordable full) size runs the end-to-end "
+         "scenario — shaped collectives, elastic shrink/regrow, autopilot "
+         "fence — and every size runs the rendezvous + control-plane "
+         "benches recorded in BENCH_SCALE.json.")
+_declare("BAGUA_SCALE_SHAPE", "str", "pod",
+         "Link-shape model for the pod simulator's data plane: a preset "
+         "name (off|pod|wan) or a JSON ShapeSpec object — per-class "
+         "latency/bandwidth/jitter for ICI vs DCN edges.  See "
+         "bagua_tpu.podsim.shaping.SHAPE_PRESETS and docs/podsim.md.")
+_declare("BAGUA_SCALE_SEED", "int", "0",
+         "Determinism seed for the pod simulator: the shaped links' "
+         "jitter hash and the drill's per-rank gradient vectors both "
+         "derive from it, so two runs at one seed inject identical "
+         "network time.")
 
 
 # ---- typed accessors -----------------------------------------------------
@@ -932,6 +949,23 @@ def get_ckpt_quarantined_paths() -> list:
     if not raw:
         return []
     return [p.strip() for p in raw.splitlines() if p.strip()]
+
+
+def get_scale_ranks() -> list:
+    """World sizes the scale drill sweeps, parsed to ints (bad entries
+    raise — a silently skipped size would fake coverage)."""
+    return [int(p) for p in env_str("BAGUA_SCALE_RANKS").split(",")
+            if p.strip()]
+
+
+def get_scale_shape() -> str:
+    """Raw link-shape selector (preset name or JSON); parsing lives in
+    :func:`bagua_tpu.podsim.shaping.resolve_shape`."""
+    return env_str("BAGUA_SCALE_SHAPE")
+
+
+def get_scale_seed() -> int:
+    return env_int("BAGUA_SCALE_SEED")
 
 
 def get_elastic_store_addr() -> Optional[str]:

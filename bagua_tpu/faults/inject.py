@@ -40,6 +40,13 @@ Points and what firing them does:
                         boundary is never applied by that rank — the
                         bounded-staleness tracker must detect the lag and
                         force a synchronous catch-up average
+``podsim.link``         the pod simulator's shaped loopback links
+                        (:mod:`bagua_tpu.podsim.shaping`): ``drop`` eats one
+                        shaped hop's payload (a ``ConnectionError`` to the
+                        transport); ``partition`` severs the DCN links of the
+                        slice named by ``rank`` for ``duration_s`` seconds —
+                        intra-slice traffic keeps flowing, like a real
+                        inter-slice network cut
 ======================  =====================================================
 
 Every armed/fired/recovered event lands in
@@ -77,6 +84,7 @@ FAULT_POINTS = (
     "grad.poison",
     "step.straggle",
     "async.partition",
+    "podsim.link",
 )
 
 #: default fault kind per point (the only kind most points support)
@@ -89,6 +97,7 @@ _DEFAULT_KINDS = {
     "grad.poison": "nan",
     "step.straggle": "dilate",
     "async.partition": "drop",
+    "podsim.link": "drop",
 }
 
 _VALID_KINDS = {
@@ -100,6 +109,7 @@ _VALID_KINDS = {
     "grad.poison": ("nan", "inf"),
     "step.straggle": ("dilate",),
     "async.partition": ("drop",),
+    "podsim.link": ("drop", "partition"),
 }
 
 

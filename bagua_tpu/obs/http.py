@@ -146,7 +146,29 @@ class _Handler(BaseHTTPRequestHandler):
             self._not_found("no fleet record (not the coordinator, or no "
                             "snapshot merged yet)")
             return
-        self._json(record)
+        self._respond(200, self._fleet_body(record), "application/json")
+
+    def _fleet_body(self, record: dict) -> str:
+        # /fleet is the one route whose payload is identical between
+        # monitor ticks but whose serialization grows with world size
+        # (O(nnodes) record, sorted keys, indentation) — under a many-
+        # scraper load at pod scale the coordinator burned its single
+        # monitor core re-rendering the same record per request.  Cache
+        # the rendered body keyed on record object IDENTITY: the monitor
+        # loop builds a fresh record object per tick, so `is` is exactly
+        # "same tick's record" with no hashing or deep comparison.
+        if not getattr(self.server, "cache_fleet_json", True):
+            return json.dumps(record, indent=1, sort_keys=True)
+        lock = getattr(self.server, "fleet_cache_lock", None)
+        if lock is None:
+            lock = self.server.fleet_cache_lock = threading.Lock()
+            self.server.fleet_json_cache = [None, ""]
+        with lock:
+            cache = self.server.fleet_json_cache
+            if cache[0] is not record:
+                cache[0] = record
+                cache[1] = json.dumps(record, indent=1, sort_keys=True)
+            return cache[1]
 
     def _history(self, query) -> None:
         historian = getattr(self.server, "historian", None)
@@ -179,13 +201,16 @@ class ObsHTTPServer:
 
     def __init__(self, port: Optional[int] = None, addr: Optional[str] = None,
                  fleet_provider: Optional[Callable[[], Optional[dict]]] = None,
-                 historian=None):
+                 historian=None, cache_fleet_json: bool = True):
         self._requested_port = int(
             _env.get_obs_http_port() if port is None else port
         )
         self.addr = str(_env.get_obs_http_addr() if addr is None else addr)
         self._fleet_provider = fleet_provider
         self._historian = historian
+        # cache_fleet_json=False restores per-request /fleet rendering —
+        # the scale drill's before/after benchmark knob
+        self._cache_fleet_json = bool(cache_fleet_json)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -212,6 +237,9 @@ class ObsHTTPServer:
         self._httpd.daemon_threads = True
         self._httpd.fleet_provider = self._fleet_provider
         self._httpd.historian = self._historian
+        self._httpd.cache_fleet_json = self._cache_fleet_json
+        self._httpd.fleet_cache_lock = threading.Lock()
+        self._httpd.fleet_json_cache = [None, ""]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="bagua-obs-http",
             daemon=True,

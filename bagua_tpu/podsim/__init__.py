@@ -1,0 +1,74 @@
+"""Pod-scale proof harness: many real processes, one simulated pod.
+
+Every DCN/throughput claim in the hierarchical-collective, codec, and
+autopilot PRs was validated on an 8-device single-process cpu-sim or via
+jaxpr byte accounting.  The coordinator-side machinery those claims lean
+on — rendezvous, lease tracking, historian ingest, autopilot decisions,
+the ``/fleet`` HTTP plane — is only credible if it holds up at pod-scale
+world sizes.  This package converts "should work on a pod" into a
+measurable contract at 32-256 *real OS processes* on one host:
+
+* :mod:`~bagua_tpu.podsim.util` — ``reserve_port()``, the one ephemeral
+  port allocator every multi-process test and drill shares, plus the
+  store-backed barrier.
+* :mod:`~bagua_tpu.podsim.shaping` — per-link traffic shaping (latency /
+  bandwidth / deterministic jitter per ICI- vs DCN-classed link) with
+  drop/partition faults composed through
+  :mod:`bagua_tpu.faults.inject` (point ``podsim.link``).
+* :mod:`~bagua_tpu.podsim.transport` — loopback-TCP ring transport with
+  the shaper applied on every hop; addresses rendezvous through the
+  restart TCPStore.
+* :mod:`~bagua_tpu.podsim.collectives` — the two-level hierarchical
+  ring allreduce (intra reduce-scatter, inter ring over the 1/intra
+  shard with the uint8 min-max wire codec on the DCN tier, intra
+  allgather) executed byte-for-byte over the shaped transport.
+* :mod:`~bagua_tpu.podsim.worker` — one simulated node: joins the REAL
+  elastic-membership rendezvous, heartbeats a REAL lease, runs the
+  shaped data plane, follows stop/resize/halt fences.
+* :mod:`~bagua_tpu.podsim.orchestrator` — plays every node's launcher at
+  once: hosts the restart TCPStore, runs the real
+  :class:`~bagua_tpu.elastic.coordinator.ElasticCoordinator` /
+  :class:`~bagua_tpu.elastic.membership.LeaseTracker` /
+  :class:`~bagua_tpu.obs.historian.Historian` /
+  :class:`~bagua_tpu.autopilot.engine.AutopilotEngine` /
+  :class:`~bagua_tpu.obs.http.ObsHTTPServer` stack over N worker
+  processes.
+
+Import-light (no jax) by construction: a 128-rank drill cannot afford a
+jax import per simulated rank, so workers install a namespace-package
+shim for ``bagua_tpu`` and import only the elastic/store/obs modules that
+are themselves jax-free.  ``scripts/scale_drill.py`` drives the drill
+matrix and writes ``BENCH_SCALE.json``; see ``docs/podsim.md``.
+"""
+
+from .shaping import (  # noqa: F401
+    LINK_DCN,
+    LINK_ICI,
+    LinkDropped,
+    LinkSevered,
+    LinkShaper,
+    LinkSpec,
+    ShapeSpec,
+    SHAPE_PRESETS,
+    classify_link,
+    resolve_shape,
+    transfer_time_s,
+)
+from .util import reserve_port, reserve_ports, store_barrier  # noqa: F401
+
+__all__ = [
+    "LINK_DCN",
+    "LINK_ICI",
+    "LinkDropped",
+    "LinkSevered",
+    "LinkShaper",
+    "LinkSpec",
+    "SHAPE_PRESETS",
+    "ShapeSpec",
+    "classify_link",
+    "resolve_shape",
+    "reserve_port",
+    "reserve_ports",
+    "store_barrier",
+    "transfer_time_s",
+]

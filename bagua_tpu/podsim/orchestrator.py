@@ -1,0 +1,304 @@
+"""The pod simulator's coordinator side: spawn N real worker processes,
+run the REAL control plane against them, measure everything.
+
+:class:`PodSim` is the launcher a scale drill scripts against.  It hosts
+the restart TCPStore, runs :class:`~bagua_tpu.elastic.coordinator.
+ElasticCoordinator` rendezvous rounds, polls leases with
+:class:`~bagua_tpu.elastic.membership.LeaseTracker`, merges heartbeat
+health into ``bagua-obs-fleet-v1`` records
+(:func:`~bagua_tpu.obs.export.build_fleet_record`), feeds the telemetry
+historian and the autopilot engine, serves the coordinator ``/fleet``
+HTTP plane, and actuates fence/resize decisions through
+``publish_stop`` — i.e. the exact object graph ``distributed/run.py``
+assembles on node 0, minus jax.  The workers are real OS processes
+(:mod:`~bagua_tpu.podsim.worker`) joined over loopback TCP, so connect
+storms, listen backlogs, GIL-bound monitor loops and fan-in serialization
+are all REAL costs here, measured per tick in :attr:`PodSim.metrics`.
+
+Scenario primitives: ``kill``/``relaunch`` a node (lease-expiry shrink,
+standby regrow), ``set_profile`` (flip a node's heartbeat health to
+``straggler``/``slow`` mid-run and let the autopilot escalate), ``halt``
+(orderly teardown).  The drill script composes these; the chaos plane
+(``BAGUA_FAULT_PLAN`` in the workers' env) composes link faults on top.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..autopilot.engine import AutopilotEngine
+from ..autopilot.policy import Action, PolicyConfig
+from ..contrib.utils.tcp_store import TCPStore, TCPStoreServer
+from ..elastic.coordinator import ElasticCoordinator
+from ..elastic.membership import LeaseTracker, MembershipClient, WorldSpec
+from ..obs.export import build_fleet_record, validate_fleet_snapshot
+from ..obs.historian import Historian
+from ..obs.http import ObsHTTPServer
+
+logger = logging.getLogger("podsim.orchestrator")
+
+__all__ = ["PodSim"]
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "worker.py")
+
+
+class PodSim:
+    """One simulated pod.  Context-manage it — ``__exit__`` tears down
+    processes, HTTP plane, and the store server unconditionally."""
+
+    def __init__(self, world: int, workdir: str,
+                 min_nnodes: int = 1,
+                 steps: int = 0, vec_elems: int = 16384,
+                 shape: str = "pod", slice_size: int = 8, seed: int = 0,
+                 hb_interval_s: float = 0.5, lease_ttl_s: float = 4.0,
+                 join_window_s: float = 30.0, timeout_s: float = 120.0,
+                 policy: Optional[PolicyConfig] = None,
+                 http: bool = True,
+                 worker_env: Optional[Dict[str, str]] = None):
+        self.world = int(world)
+        self.workdir = str(workdir)
+        os.makedirs(self.workdir, exist_ok=True)
+        self.min_nnodes = int(min_nnodes)
+        self.steps = int(steps)
+        self.vec_elems = int(vec_elems)
+        self.shape = str(shape)
+        self.slice_size = int(slice_size)
+        self.seed = int(seed)
+        self.hb_interval_s = float(hb_interval_s)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.timeout_s = float(timeout_s)
+        self.worker_env = dict(worker_env or {})
+
+        # the coordinator stack run.py builds on node 0, minus jax
+        self.server = TCPStoreServer("127.0.0.1", 0, backend="python")
+        self.addr, self.port = self.server.address
+        self.store = TCPStore(self.addr, self.port, timeout_s=60.0)
+        self.client = MembershipClient(self.store, 0, self.world)
+        self.coord = ElasticCoordinator(
+            self.client, self.min_nnodes, self.world,
+            master_addr=self.addr, master_port=self.port,
+            join_window_s=float(join_window_s), timeout_s=self.timeout_s,
+        )
+        self.historian = Historian(capacity=4096, window_s=120.0)
+        self.engine = AutopilotEngine(
+            config=policy or PolicyConfig(
+                mode="act", sustain=2, cooldown_s=0.0, budget=8,
+                staleness_s=60.0, suspect_ttl_s=30.0,
+            ),
+            store=self.store,
+        )
+        self._fleet_record: Optional[dict] = None
+        self.http: Optional[ObsHTTPServer] = None
+        if http:
+            self.http = ObsHTTPServer(
+                port=0, addr="127.0.0.1",
+                fleet_provider=lambda: self._fleet_record,
+                historian=self.historian,
+            ).start()
+
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.spec: Optional[WorldSpec] = None
+        self.tracker: Optional[LeaseTracker] = None
+        #: drill measurements: per-phase wall times and per-tick control
+        #: loop latencies (seconds)
+        self.metrics: Dict[str, List[float]] = {
+            "rendezvous_s": [], "decide_s": [], "ingest_s": [],
+            "tick_s": [],
+        }
+
+    # ---- process control -------------------------------------------------
+
+    def log_path(self, node_id: int) -> str:
+        return os.path.join(self.workdir, f"node{node_id}.log")
+
+    def spawn(self, node_id: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        argv = [
+            sys.executable, _WORKER,
+            "--store-addr", self.addr, "--store-port", str(self.port),
+            "--node-id", str(node_id), "--max-nnodes", str(self.world),
+            "--steps", str(self.steps), "--vec-elems", str(self.vec_elems),
+            "--shape", self.shape, "--slice-size", str(self.slice_size),
+            "--seed", str(self.seed),
+            "--hb-interval", str(self.hb_interval_s),
+            "--timeout", str(self.timeout_s),
+        ]
+        log = open(self.log_path(node_id), "ab")
+        try:
+            proc = subprocess.Popen(
+                argv, stdout=log, stderr=subprocess.STDOUT,
+                start_new_session=True, env=env,
+            )
+        finally:
+            log.close()
+        self.procs[node_id] = proc
+        return proc
+
+    def spawn_all(self) -> None:
+        for nid in range(self.world):
+            self.spawn(nid)
+
+    def kill(self, node_id: int) -> None:
+        """Hard-kill one node's process — the silent-death case lease
+        expiry exists for."""
+        proc = self.procs.get(node_id)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def alive(self) -> List[int]:
+        return sorted(n for n, p in self.procs.items() if p.poll() is None)
+
+    # ---- control plane ---------------------------------------------------
+
+    def rendezvous(self, epoch: int,
+                   expect: Optional[List[int]] = None) -> WorldSpec:
+        """One coordinator round; wall time lands in
+        ``metrics['rendezvous_s']``."""
+        t0 = time.monotonic()
+        spec = self.coord.run_round(epoch, expect=expect)
+        self.metrics["rendezvous_s"].append(time.monotonic() - t0)
+        self.spec = spec
+        self.tracker = LeaseTracker(
+            self.client, spec.epoch, sorted(spec.ranks),
+            ttl_s=self.lease_ttl_s,
+        )
+        return spec
+
+    def set_profile(self, node_id: int, profile: str) -> None:
+        self.store.set(f"podsim/profile/{node_id}", profile)
+
+    def ok_ids(self, spec: WorldSpec) -> List[int]:
+        members = sorted(spec.ranks)
+        vals = self.store.mget(
+            [f"podsim/{spec.epoch}/ok/{n}" for n in members])
+        return [n for n, v in zip(members, vals) if v is not None]
+
+    def ok_verdicts(self, spec: WorldSpec) -> Dict[int, dict]:
+        members = sorted(spec.ranks)
+        vals = self.store.mget(
+            [f"podsim/{spec.epoch}/ok/{n}" for n in members])
+        return {n: json.loads(v) for n, v in zip(members, vals)
+                if v is not None}
+
+    def _observe_tick(self, spec: WorldSpec) -> List[Action]:
+        """One monitor-loop body: poll leases, merge health, historian,
+        autopilot — each stage timed."""
+        t0 = time.monotonic()
+        expired = self.tracker.poll()
+        members = {n: self.tracker.health_of(n) for n in sorted(spec.ranks)}
+        record = build_fleet_record(spec.epoch, members)
+        problems = validate_fleet_snapshot(record)
+        if problems:
+            raise AssertionError(f"fleet record invalid: {problems}")
+        t1 = time.monotonic()
+        self.historian.ingest(record)
+        t2 = time.monotonic()
+        actions = self.engine.observe_snapshot(record)
+        t3 = time.monotonic()
+        self._fleet_record = record
+        self.metrics["ingest_s"].append(t2 - t1)
+        self.metrics["decide_s"].append(t3 - t2)
+        self.metrics["tick_s"].append(t3 - t0)
+        if expired:
+            self.client.publish_stop(
+                spec.epoch, "lease_expired", expired[0],
+                f"lease(s) expired after {self.lease_ttl_s:.1f}s: {expired}",
+                rejoin=False, nodes=expired,
+            )
+        return actions
+
+    def monitor(self, spec: WorldSpec, until: str = "all_ok",
+                max_s: float = 60.0,
+                tick_s: float = 0.25) -> Tuple[str, List[int]]:
+        """Run the coordinator monitor loop until a verdict:
+
+        * ``("all_ok", members)`` — every member wrote its epoch verdict
+          (``until="all_ok"``)
+        * ``("fenced", nodes)`` — the autopilot decided fence/resize; the
+          stop is published (``rejoin=False``) before returning
+        * ``("expired", nodes)`` — a lease ran out; stop published
+        * ``("timeout", [])`` — ``max_s`` elapsed without a verdict
+        """
+        deadline = time.monotonic() + max_s
+        while time.monotonic() < deadline:
+            actions = self._observe_tick(spec)
+            stop = self.client.read_stop(spec.epoch)
+            if stop is not None and stop.get("kind") == "lease_expired":
+                return "expired", list(stop.get("nodes") or [])
+            for action in actions:
+                if action.kind not in ("fence", "resize"):
+                    continue
+                targets = [int(t) for t in (
+                    action.target if isinstance(action.target, (list, tuple))
+                    else [action.target])]
+                self.client.publish_stop(
+                    spec.epoch, f"autopilot_{action.kind}", targets[0],
+                    action.reason, rejoin=False, nodes=targets,
+                )
+                self.engine.note_actuated(action)
+                return "fenced", targets
+            if until == "all_ok" and \
+                    len(self.ok_ids(spec)) == spec.nnodes:
+                return "all_ok", sorted(spec.ranks)
+            time.sleep(tick_s)
+        return "timeout", []
+
+    def standby_ids(self) -> List[int]:
+        return self.coord.standby_ids(self.spec) if self.spec else []
+
+    # ---- teardown --------------------------------------------------------
+
+    def halt(self, reason: str = "drill complete") -> None:
+        self.client.publish_halt(0, reason)
+
+    def wait_all(self, timeout_s: float = 30.0) -> Dict[int, Optional[int]]:
+        """Reap every worker; returns node -> exit code (None = had to be
+        killed)."""
+        codes: Dict[int, Optional[int]] = {}
+        deadline = time.monotonic() + timeout_s
+        for nid, proc in sorted(self.procs.items()):
+            try:
+                codes[nid] = proc.wait(
+                    timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+                codes[nid] = None
+        return codes
+
+    def shutdown(self) -> None:
+        try:
+            self.halt("shutdown")
+        except Exception:  # noqa: BLE001 - teardown must not raise
+            pass
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        if self.http is not None:
+            self.http.stop()
+            self.http = None
+        try:
+            self.store._sock.close()  # TCPStore has no close(); be tidy
+        except Exception:  # noqa: BLE001
+            pass
+        self.server.stop()
+
+    def __enter__(self) -> "PodSim":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -119,6 +119,16 @@ JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 python -m bagua_tpu.obs.regress --out BENCH_TREND.json \
   || echo "advisory: bench trend sentinel reported a problem (non-blocking)"
 
+echo "=== scale smoke (4-process loopback pod drill) ==="
+# The pod simulator end to end with REAL worker processes: cold-start
+# rendezvous through the restart TCPStore, shaped hierarchical+compressed
+# collectives over loopback rings, lease-expiry shrink, standby regrow,
+# and an autopilot straggler fence — the full coordinator lifecycle at
+# world 4 under a tight timeout.  The committed 32/64/128-rank sweep
+# (BENCH_SCALE.json) is schema-gated in tests/test_bench_sanity.py;
+# regenerate it with `python scripts/scale_drill.py`.
+timeout -k 10 120 python scripts/scale_drill.py --smoke > /dev/null
+
 echo "=== chaos fast subset (fault injection -> detection -> recovery) ==="
 # The deterministic slice of scripts/chaos_drill.py: every injection point
 # fires, every detector sees it, every recovery completes.  The committed

@@ -22,19 +22,15 @@ Usage: python scripts/elastic_drill.py
 import json
 import os
 import signal
-import socket
 import subprocess
 import sys
 import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from bagua_tpu.podsim.util import reserve_port as _free_port  # noqa: E402
 
 
 def _wait_for(path: str, needle: str, timeout_s: float) -> bool:

@@ -53,6 +53,11 @@ MODULES = [
     "bagua_tpu.obs.http",
     "bagua_tpu.autopilot.policy",
     "bagua_tpu.autopilot.engine",
+    "bagua_tpu.podsim.util",
+    "bagua_tpu.podsim.shaping",
+    "bagua_tpu.podsim.collectives",
+    "bagua_tpu.podsim.transport",
+    "bagua_tpu.podsim.orchestrator",
     "bagua_tpu.profiling",
     "bagua_tpu.parallel.mesh",
     "bagua_tpu.parallel.tensor_parallel",

@@ -1,0 +1,515 @@
+#!/usr/bin/env python
+"""Pod-scale proof drill: drive the REAL coordinator stack with 32-256
+worker processes and record how it scales (BENCH_SCALE.json).
+
+Two layers per world size in ``BAGUA_SCALE_RANKS``:
+
+* **live** — :class:`bagua_tpu.podsim.orchestrator.PodSim` spawns that
+  many real OS processes through the production rendezvous / lease /
+  heartbeat path over loopback TCP.  The first (smallest) size runs the
+  FULL scenario: cold-start rendezvous -> shaped hierarchical+compressed
+  collectives (link-shaped ICI/DCN physics) -> lease-expiry shrink ->
+  standby regrow -> autopilot straggler fence -> teardown, each phase
+  asserted.  Larger sizes run the light scenario (rendezvous + monitor
+  ticks + teardown) — same control plane, no per-step data plane, so one
+  CI core can afford 128 processes.
+* **bench** — process-free microbenches of the coordinator hot paths at
+  that world size: fleet-record decision latency (autopilot policy
+  matrix), historian ingest rate, coordinator ``/fleet`` HTTP p99, and
+  the restart-store connect storm.
+
+The connect-storm bench measures the TCPStore listen-backlog bottleneck
+before/after (socketserver's default 5-deep accept queue drops SYNs
+under a pod-scale reconnect herd; ``_Server.request_queue_size = 256``
+is the fix), and the HTTP bench measures ``/fleet`` with the render
+cache off/on (per-request ``json.dumps`` of an O(nnodes) record burned
+the monitor core under scraper load) — the two coordinator fixes this
+drill exists to keep honest.
+
+Usage::
+
+    python scripts/scale_drill.py            # full sweep, writes BENCH_SCALE.json
+    python scripts/scale_drill.py --smoke    # 4-process scenario only (CI step)
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __package__ in (None, ""):  # import-light shim: no jax in the drill
+    import importlib.util
+
+    sys.path.insert(0, _REPO)
+    _spec = importlib.util.spec_from_loader(
+        "bagua_tpu", loader=None, is_package=True)
+    _pkg = importlib.util.module_from_spec(_spec)
+    _pkg.__path__ = [os.path.join(_REPO, "bagua_tpu")]
+    sys.modules["bagua_tpu"] = _pkg
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import logging  # noqa: E402
+import socket  # noqa: E402
+import tempfile  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
+import urllib.request  # noqa: E402
+
+from bagua_tpu import env as _env  # noqa: E402
+from bagua_tpu.autopilot.engine import AutopilotEngine  # noqa: E402
+from bagua_tpu.autopilot.policy import PolicyConfig  # noqa: E402
+from bagua_tpu.contrib.utils import tcp_store as _tcp  # noqa: E402
+from bagua_tpu.obs.export import build_fleet_record  # noqa: E402
+from bagua_tpu.obs.historian import Historian  # noqa: E402
+from bagua_tpu.obs.http import ObsHTTPServer  # noqa: E402
+from bagua_tpu.podsim.orchestrator import PodSim  # noqa: E402
+
+logger = logging.getLogger("scale_drill")
+
+SCHEMA = "bagua-bench-scale-v1"
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    vals = sorted(values)
+    idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+    return vals[idx]
+
+
+def _ms(seconds):
+    return None if seconds is None else round(seconds * 1e3, 3)
+
+
+def _policy():
+    return PolicyConfig(mode="act", sustain=2, cooldown_s=0.0, budget=8,
+                        staleness_s=60.0, suspect_ttl_s=30.0)
+
+
+# ---------------------------------------------------------------------------
+# live scenarios (real processes)
+# ---------------------------------------------------------------------------
+
+
+def full_scenario(world, workdir, shape, seed, steps=1, vec_elems=8192,
+                  slice_size=8, timeout_s=180.0):
+    """The end-to-end proof at one world size: every phase of the
+    coordinator's life driven against real processes, every phase
+    asserted.  Returns (checks, metrics)."""
+    checks = {}
+    t0 = time.monotonic()
+    with PodSim(world, workdir, min_nnodes=2, steps=steps,
+                vec_elems=vec_elems, shape=shape, slice_size=slice_size,
+                seed=seed, lease_ttl_s=4.0, join_window_s=60.0,
+                timeout_s=timeout_s, policy=_policy()) as sim:
+        sim.spawn_all()
+        spec = sim.rendezvous(1)
+        checks["cold_start_full_world"] = spec.nnodes == world
+        verdict, _ = sim.monitor(spec, until="all_ok", max_s=timeout_s)
+        checks["shaped_collectives_ok"] = verdict == "all_ok"
+        verdicts = sim.ok_verdicts(spec)
+        checks["collectives_within_quant_tolerance"] = bool(verdicts) and all(
+            v.get("max_err", 0.0) <= v.get("atol", 1.0)
+            for v in verdicts.values() if not v.get("skipped")
+        )
+        dcn_hops = sum(
+            v.get("shaping", {}).get("dcn", {}).get("hops", 0)
+            for v in verdicts.values())
+        checks["dcn_tier_exercised"] = dcn_hops > 0
+
+        # elastic shrink: hard-kill the highest node, lease must expire
+        victim = world - 1
+        sim.kill(victim)
+        verdict, who = sim.monitor(spec, until="stop", max_s=60.0)
+        checks["lease_expiry_detected"] = (
+            verdict == "expired" and who == [victim])
+        survivors = [n for n in range(world) if n != victim]
+        spec = sim.rendezvous(2, expect=survivors)
+        checks["shrunk_world"] = spec.nnodes == world - 1
+        verdict, _ = sim.monitor(spec, until="all_ok", max_s=timeout_s)
+        checks["post_shrink_collectives_ok"] = verdict == "all_ok"
+
+        # regrow: relaunch the victim, admit it at the next boundary
+        sim.spawn(victim)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and not sim.standby_ids():
+            time.sleep(0.3)
+        checks["standby_detected"] = victim in sim.standby_ids()
+        spec = sim.rendezvous(3, expect=list(range(world)))
+        checks["regrown_world"] = spec.nnodes == world
+        verdict, _ = sim.monitor(spec, until="all_ok", max_s=timeout_s)
+        checks["post_regrow_collectives_ok"] = verdict == "all_ok"
+
+        # autopilot observe->act: a chronic straggler profile must be
+        # fenced through the real policy matrix + stop/resize machinery
+        straggler = world // 2
+        sim.set_profile(straggler, "straggler")
+        verdict, who = sim.monitor(spec, until="stop", max_s=60.0,
+                                   tick_s=0.5)
+        checks["autopilot_fenced_straggler"] = (
+            verdict == "fenced" and who == [straggler])
+        spec = sim.rendezvous(
+            4, expect=[n for n in range(world) if n != straggler])
+        checks["post_fence_world"] = spec.nnodes == world - 1
+        verdict, _ = sim.monitor(spec, until="all_ok", max_s=timeout_s)
+        checks["post_fence_collectives_ok"] = verdict == "all_ok"
+
+        # coordinator HTTP plane serves the live fleet + historian trends
+        try:
+            fleet = json.load(urllib.request.urlopen(
+                sim.http.url + "/fleet", timeout=10))
+            hist = json.load(urllib.request.urlopen(
+                sim.http.url + "/history?metric=goodput_fraction",
+                timeout=10))
+            checks["http_fleet_live"] = (
+                fleet.get("schema") == "bagua-obs-fleet-v1"
+                and fleet.get("nnodes") == spec.nnodes)
+            checks["http_history_live"] = bool(hist.get("ranks"))
+        except Exception as e:  # noqa: BLE001 - recorded, not raised
+            logger.warning("http check failed: %s", e)
+            checks["http_fleet_live"] = checks["http_history_live"] = False
+
+        sim.halt()
+        codes = sim.wait_all(timeout_s=60.0)
+        checks["fenced_node_exit_code"] = codes.get(straggler) == 4
+        checks["survivors_exit_clean"] = all(
+            c == 0 for n, c in codes.items() if n != straggler)
+        metrics = _live_metrics(sim)
+    metrics["wall_s"] = round(time.monotonic() - t0, 1)
+    metrics["scenario"] = "full"
+    return checks, metrics
+
+
+def light_scenario(world, workdir, shape, seed, ticks=5, timeout_s=None):
+    """Control-plane-only live run at one world size: real processes,
+    real rendezvous/leases/monitor ticks, no per-step data plane."""
+    checks = {}
+    t0 = time.monotonic()
+    # cold start on single-core CI is serial process boot (~1.3 s/worker
+    # under load, measured in BENCH_SCALE.json rendezvous_s) — the join
+    # window and worker deadline must scale with world or 128 ranks can
+    # never all arrive
+    join_window_s = max(120.0, 2.0 * world)
+    if timeout_s is None:
+        timeout_s = max(240.0, 3.0 * world)
+    with PodSim(world, workdir, min_nnodes=2, steps=0, shape=shape,
+                seed=seed, hb_interval_s=1.0, lease_ttl_s=8.0,
+                join_window_s=join_window_s, timeout_s=timeout_s,
+                policy=_policy()) as sim:
+        sim.spawn_all()
+        spec = sim.rendezvous(1)
+        checks["cold_start_full_world"] = spec.nnodes == world
+        verdict, _ = sim.monitor(spec, until="all_ok", max_s=timeout_s)
+        checks["all_members_reported"] = verdict == "all_ok"
+        for _ in range(ticks):
+            sim._observe_tick(spec)
+            time.sleep(0.1)
+        sim.halt()
+        codes = sim.wait_all(timeout_s=60.0)
+        checks["all_exit_clean"] = all(c == 0 for c in codes.values())
+        metrics = _live_metrics(sim)
+    metrics["wall_s"] = round(time.monotonic() - t0, 1)
+    metrics["scenario"] = "light"
+    return checks, metrics
+
+
+def _live_metrics(sim):
+    m = sim.metrics
+    return {
+        "rendezvous_s": [round(v, 3) for v in m["rendezvous_s"]],
+        "cold_start_rendezvous_s": round(m["rendezvous_s"][0], 3)
+        if m["rendezvous_s"] else None,
+        "monitor_tick_p50_ms": _ms(_percentile(m["tick_s"], 0.5)),
+        "monitor_tick_p99_ms": _ms(_percentile(m["tick_s"], 0.99)),
+        "decide_p99_ms": _ms(_percentile(m["decide_s"], 0.99)),
+        "ingest_p99_ms": _ms(_percentile(m["ingest_s"], 0.99)),
+    }
+
+
+# ---------------------------------------------------------------------------
+# control-plane microbenches (no processes)
+# ---------------------------------------------------------------------------
+
+
+def synth_record(world, t, straggler=None):
+    """A ``bagua-obs-fleet-v1`` record of ``world`` ranks at time ``t``
+    with enough numeric freight to make ingest/decide/serialize do real
+    per-rank work."""
+    members = {}
+    for n in range(world):
+        obs = {
+            "rank": n, "step": int(t), "goodput_fraction": 0.91,
+            "step_dt_s": 0.105, "hbm_headroom_bytes": 2.0e9,
+            "dcn_device_s": 0.012, "worst_badput_class": "collective_wait",
+        }
+        if n == straggler:
+            obs["straggler_suspect"] = {
+                "rank": n, "ratio": 5.0, "detected_at_unix": t,
+                "dominant_phase": "dispatch",
+            }
+        members[n] = {"obs": obs}
+    record = build_fleet_record(0, members)
+    record["time_unix"] = float(t)
+    return record
+
+
+def bench_decision_latency(world, samples=60):
+    """Autopilot decide() wall time per fleet snapshot at this world
+    size (the monitor loop pays this every tick)."""
+    engine = AutopilotEngine(config=_policy())
+    base = time.time()
+    times = []
+    for i in range(samples):
+        record = synth_record(world, base + i,
+                              straggler=(world // 2 if i % 7 == 0 else None))
+        t0 = time.monotonic()
+        engine.observe_snapshot(record, now=base + i)
+        times.append(time.monotonic() - t0)
+    return {"p50_ms": _ms(_percentile(times, 0.5)),
+            "p99_ms": _ms(_percentile(times, 0.99)),
+            "samples": samples}
+
+
+def bench_historian_ingest(world, samples=60):
+    """Historian records/second at this world size (every rank of every
+    record feeds per-metric ring buffers + trend publication)."""
+    historian = Historian(capacity=4096, window_s=300.0)
+    base = time.time()
+    records = [synth_record(world, base + i) for i in range(samples)]
+    t0 = time.monotonic()
+    for r in records:
+        historian.ingest(r)
+    wall = time.monotonic() - t0
+    return {"records_per_s": round(samples / wall, 1) if wall > 0 else None,
+            "per_record_ms": _ms(wall / samples)}
+
+
+def bench_http_fleet(world, requests=120, threads=4, cache=True):
+    """Coordinator ``/fleet`` latency under concurrent scrapers.
+    ``cache=False`` re-renders the JSON per request — the pre-fix
+    behavior, kept measurable as the before branch."""
+    record = synth_record(world, time.time())
+    server = ObsHTTPServer(port=0, addr="127.0.0.1",
+                           fleet_provider=lambda: record,
+                           cache_fleet_json=cache).start()
+    url = server.url + "/fleet"
+    times, errors = [], []
+    lock = threading.Lock()
+
+    def scrape(n):
+        for _ in range(n):
+            t0 = time.monotonic()
+            try:
+                with urllib.request.urlopen(url, timeout=30) as resp:
+                    resp.read()
+            except Exception as e:  # noqa: BLE001 - recorded
+                with lock:
+                    errors.append(str(e))
+                continue
+            with lock:
+                times.append(time.monotonic() - t0)
+
+    pool = [threading.Thread(target=scrape, args=(requests // threads,))
+            for _ in range(threads)]
+    t_all = time.monotonic()
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+    wall = time.monotonic() - t_all
+    server.stop()
+    return {"p50_ms": _ms(_percentile(times, 0.5)),
+            "p99_ms": _ms(_percentile(times, 0.99)),
+            "requests_per_s": round(len(times) / wall, 1) if wall else None,
+            "errors": len(errors)}
+
+
+def bench_connect_storm(clients, backlog):
+    """``clients`` concurrent TCPStore connect+set+get against one
+    python store server with the given listen backlog — the pod
+    cold-start fan-in.  Returns wall time and worst connect latency
+    (SYN drops surface as >= 1 s retransmit stalls)."""
+    old = _tcp._Server.request_queue_size
+    _tcp._Server.request_queue_size = backlog
+    try:
+        server = _tcp.TCPStoreServer("127.0.0.1", 0, backend="python")
+    finally:
+        _tcp._Server.request_queue_size = old
+    addr, port = server.address
+    times, errors = [], []
+    lock = threading.Lock()
+    gate = threading.Barrier(clients + 1)
+
+    def dial(i):
+        try:
+            gate.wait(timeout=30)
+            t0 = time.monotonic()
+            client = _tcp.TCPStore(addr, port, timeout_s=30.0)
+            dt = time.monotonic() - t0
+            client.set(f"storm/{i}", b"1")
+            assert client.get(f"storm/{i}") == b"1"
+            client._sock.close()
+            with lock:
+                times.append(dt)
+        except Exception as e:  # noqa: BLE001 - recorded
+            with lock:
+                errors.append(str(e))
+
+    pool = [threading.Thread(target=dial, args=(i,))
+            for i in range(clients)]
+    for t in pool:
+        t.start()
+    gate.wait(timeout=30)
+    t_all = time.monotonic()
+    for t in pool:
+        t.join(timeout=120)
+    wall = time.monotonic() - t_all
+    server.stop()
+    return {"backlog": backlog, "clients": clients,
+            "wall_s": round(wall, 3),
+            "connect_p99_ms": _ms(_percentile(times, 0.99)),
+            "connect_max_ms": _ms(max(times) if times else None),
+            "errors": len(errors)}
+
+
+# ---------------------------------------------------------------------------
+# the drill
+# ---------------------------------------------------------------------------
+
+
+def run_smoke(args):
+    workdir = tempfile.mkdtemp(prefix="podsim_smoke_")
+    checks, metrics = full_scenario(
+        4, workdir, shape=args.shape, seed=args.seed, steps=2,
+        vec_elems=4096, slice_size=2, timeout_s=90.0)
+    verdict = {"drill": "scale-smoke", "world": 4, "checks": checks,
+               "metrics": metrics, "log_dir": workdir,
+               "ok": all(checks.values())}
+    print(json.dumps(verdict, indent=1, sort_keys=True))
+    return 0 if verdict["ok"] else 1
+
+
+def run_full(args):
+    t0 = time.monotonic()
+    worlds = {}
+    all_checks = {}
+    base_dir = tempfile.mkdtemp(prefix="podsim_drill_")
+    for i, world in enumerate(args.ranks):
+        workdir = os.path.join(base_dir, f"w{world}")
+        logger.info("=== world %d: live %s scenario ===", world,
+                    "full" if i == 0 else "light")
+        if i == 0:
+            checks, live = full_scenario(
+                world, workdir, shape=args.shape, seed=args.seed,
+                steps=args.steps, slice_size=args.slice_size)
+        else:
+            checks, live = light_scenario(
+                world, workdir, shape=args.shape, seed=args.seed)
+        for name, ok in checks.items():
+            all_checks[f"w{world}/{name}"] = ok
+        logger.info("=== world %d: control-plane benches ===", world)
+        worlds[str(world)] = {
+            "live": {**live, "checks": checks},
+            "decision_latency": bench_decision_latency(world),
+            "historian_ingest": bench_historian_ingest(world),
+            "http_fleet": bench_http_fleet(world, cache=True),
+        }
+
+    # bottleneck before/after: measured once at the largest swept size
+    top = max(args.ranks)
+    logger.info("=== bottleneck before/after @ %d ===", top)
+    storm_clients = min(2 * top, 256)
+    backlog_before = bench_connect_storm(storm_clients, backlog=5)
+    backlog_after = bench_connect_storm(
+        storm_clients, backlog=_tcp._Server.request_queue_size)
+    http_before = bench_http_fleet(top, cache=False)
+    http_after = bench_http_fleet(top, cache=True)
+    bottlenecks = {
+        "tcp_store_listen_backlog": {
+            "problem": "socketserver default backlog 5 drops cold-start "
+                       "connect-storm SYNs; clients stall >= 1s on "
+                       "retransmit",
+            "fix": "contrib/utils/tcp_store.py: _Server.request_queue_size "
+                   f"= {_tcp._Server.request_queue_size}",
+            "before": backlog_before, "after": backlog_after,
+        },
+        "fleet_json_rerender": {
+            "problem": "/fleet re-ran json.dumps of the O(nnodes) record "
+                       "per request, burning the monitor core under "
+                       "concurrent scrapers",
+            "fix": "obs/http.py: render cache keyed on record identity "
+                   "(cache_fleet_json=False restores the old path)",
+            "before": http_before, "after": http_after,
+        },
+    }
+    all_checks["backlog_fix_no_slower"] = (
+        backlog_after["errors"] == 0
+        and (backlog_before["connect_max_ms"] is None
+             or backlog_after["connect_max_ms"]
+             <= backlog_before["connect_max_ms"] * 1.5 + 50.0))
+    all_checks["fleet_cache_no_slower"] = (
+        http_after["errors"] == 0
+        and http_after["p99_ms"] <= http_before["p99_ms"] * 1.5 + 5.0)
+
+    record = {
+        "schema": SCHEMA,
+        "drill": "scale",
+        "platform": "cpu-sim",
+        "host_cores": os.cpu_count(),
+        "shape": args.shape,
+        "seed": args.seed,
+        "worlds": worlds,
+        "bottlenecks": bottlenecks,
+        "checks": all_checks,
+        "log_dir": base_dir,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "ok": all(all_checks.values()),
+    }
+    out = os.path.abspath(args.out)
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({k: record[k] for k in
+                      ("schema", "checks", "wall_s", "ok")},
+                     indent=1, sort_keys=True))
+    print(f"wrote {out}")
+    return 0 if record["ok"] else 1
+
+
+def main(argv=None):
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="4-process full scenario only (the CI gate)")
+    ap.add_argument("--ranks", default=None,
+                    help="comma-separated world sizes "
+                         "(default: BAGUA_SCALE_RANKS)")
+    ap.add_argument("--shape", default=None,
+                    help="link shape preset/JSON (default: "
+                         "BAGUA_SCALE_SHAPE)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="determinism seed (default: BAGUA_SCALE_SEED)")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="collective steps per epoch in the full scenario")
+    ap.add_argument("--slice-size", type=int, default=8)
+    ap.add_argument("--out", default=os.path.join(_REPO, "BENCH_SCALE.json"))
+    args = ap.parse_args(argv)
+    args.shape = _env.get_scale_shape() if args.shape is None else args.shape
+    args.seed = _env.get_scale_seed() if args.seed is None else args.seed
+    if args.ranks is None:
+        args.ranks = _env.get_scale_ranks()
+    else:
+        args.ranks = [int(p) for p in str(args.ranks).split(",") if p.strip()]
+    if args.smoke:
+        return run_smoke(args)
+    if len(args.ranks) < 3:
+        ap.error(f"need >= 3 world sizes for the sweep, got {args.ranks}")
+    return run_full(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -225,6 +225,50 @@ def test_bench_compress_artifact_schema():
         assert tier_dev["rationale"]
 
 
+def test_scale_bench_artifact_schema():
+    """BENCH_SCALE.json (driver-visible artifact of scripts/scale_drill.py):
+    the committed record must show the multi-process drill passing at >= 3
+    world sizes with all four control-plane metrics recorded, and both
+    identified coordinator bottlenecks measured before AND after their fix
+    (regenerate with `python scripts/scale_drill.py`)."""
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "BENCH_SCALE.json")
+    assert os.path.exists(path), "run scripts/scale_drill.py first"
+    record = json.load(open(path))
+    assert record["schema"] == "bagua-bench-scale-v1"
+    assert record["drill"] == "scale" and record["platform"] == "cpu-sim"
+    worlds = record["worlds"]
+    assert len(worlds) >= 3, sorted(worlds)
+    for w, data in worlds.items():
+        live = data["live"]
+        # the four scaling signals, per world size
+        assert live["cold_start_rendezvous_s"] > 0, w
+        assert data["decision_latency"]["p99_ms"] > 0, w
+        assert data["historian_ingest"]["records_per_s"] > 0, w
+        assert data["http_fleet"]["p99_ms"] > 0, w
+        for name, ok in live["checks"].items():
+            assert ok is True, (w, name)
+    # one world ran the FULL scenario (shaped collectives, shrink/regrow,
+    # autopilot fence); the rest may be control-plane-only
+    scenarios = {d["live"]["scenario"] for d in worlds.values()}
+    assert "full" in scenarios
+    # both coordinator bottlenecks: identified, fixed, before/after recorded
+    storm = record["bottlenecks"]["tcp_store_listen_backlog"]
+    assert storm["before"]["backlog"] == 5
+    assert storm["after"]["backlog"] > 5
+    assert storm["after"]["connect_p99_ms"] <= storm["before"]["connect_p99_ms"]
+    assert storm["after"]["errors"] == 0
+    cache = record["bottlenecks"]["fleet_json_rerender"]
+    assert cache["after"]["requests_per_s"] >= cache["before"]["requests_per_s"]
+    assert cache["after"]["errors"] == 0
+    for name, ok in record["checks"].items():
+        assert ok is True, name
+    assert record["ok"] is True
+
+
 def test_chaos_drill_artifact_schema():
     """CHAOS_DRILL.json (driver-visible artifact of scripts/chaos_drill.py):
     the committed record must cover the full fault matrix with every fault

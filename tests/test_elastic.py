@@ -429,6 +429,25 @@ def test_restart_store_retries_non_oserror_timeout(store_server, monkeypatch):
     assert rs._client is not flaky, "connection must have been refreshed"
 
 
+def test_connect_restart_store_failure_chains_cause_and_counts_attempts():
+    """When the restart store never comes up, the raised error must carry
+    the attempt count and chain the last socket error as __cause__ —
+    'Connection refused' alone doesn't say the launcher retried at all."""
+    from bagua_tpu.distributed.run import _connect_restart_store
+    from bagua_tpu.podsim.util import reserve_port
+
+    # a reserved-but-unserved port: connects fail fast with ECONNREFUSED
+    dead_port = reserve_port()
+    args = SimpleNamespace(master_addr="127.0.0.1",
+                           restart_coordinator_port=dead_port)
+    with pytest.raises(ConnectionError) as e:
+        _connect_restart_store(args, timeout_s=0.5)
+    msg = str(e.value)
+    assert f"127.0.0.1:{dead_port}" in msg
+    assert "attempt" in msg and "last error" in msg
+    assert isinstance(e.value.__cause__, OSError)
+
+
 # ---------------------------------------------------------------------------
 # resize hooks
 # ---------------------------------------------------------------------------

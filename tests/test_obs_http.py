@@ -29,6 +29,7 @@ from bagua_tpu.obs import spans as obs_spans  # noqa: E402
 from bagua_tpu.obs.historian import Historian  # noqa: E402
 from bagua_tpu.obs.http import ObsHTTPServer  # noqa: E402
 from bagua_tpu.parallel.mesh import build_mesh  # noqa: E402
+from bagua_tpu.podsim.util import reserve_port  # noqa: E402
 
 N_DEVICES = 8
 NOW = 1_754_000_000.0
@@ -194,6 +195,31 @@ def test_fleet_and_history_routes_on_coordinator():
         srv.stop()
 
 
+def test_fleet_render_cache_tracks_record_identity():
+    """/fleet serializes once per record OBJECT, not once per request —
+    and a new record object (the monitor builds one per tick) must bust
+    the cache.  cache_fleet_json=False restores the per-request path
+    (used by scale_drill's before/after bench) with identical bodies."""
+    rec_a = {"schema": "bagua-obs-fleet-v1", "time_unix": NOW, "marker": "a"}
+    rec_b = {"schema": "bagua-obs-fleet-v1", "time_unix": NOW + 1,
+             "marker": "b"}
+    holder = {"record": rec_a}
+    for cached in (True, False):
+        srv = ObsHTTPServer(port=0, fleet_provider=lambda: holder["record"],
+                            cache_fleet_json=cached).start()
+        try:
+            holder["record"] = rec_a
+            _, _, body1 = _get(srv, "/fleet")
+            _, _, body2 = _get(srv, "/fleet")
+            assert body1 == body2
+            assert json.loads(body1)["marker"] == "a"
+            holder["record"] = rec_b  # fresh object -> fresh render
+            _, _, body3 = _get(srv, "/fleet")
+            assert json.loads(body3)["marker"] == "b"
+        finally:
+            srv.stop()
+
+
 # ---- bring-up / gating -----------------------------------------------------
 
 
@@ -206,10 +232,8 @@ def test_disabled_by_default(monkeypatch):
 def test_global_server_starts_once_and_attaches_hooks(monkeypatch):
     monkeypatch.setenv("BAGUA_OBS_HTTP_PORT", "0")
     assert obs_http.maybe_start_global_http_server() is None  # 0 = off
-    # an ephemeral-but-on port: pick one by binding port 0 ourselves
-    probe = ObsHTTPServer(port=0).start()
-    free_port = probe.port
-    probe.stop()
+    # an ephemeral-but-on port, reserved so parallel tests can't steal it
+    free_port = reserve_port()
     monkeypatch.setenv("BAGUA_OBS_HTTP_PORT", str(free_port))
     monkeypatch.setattr(obs_http, "_GLOBAL_SERVER", None)
     try:
@@ -243,9 +267,7 @@ def test_unbindable_addr_falls_back_to_loopback():
 def test_stop_clears_global_server_slot(monkeypatch):
     """run_elastic's teardown stops the global server; a later bring-up
     in the same process must get a LIVE server, not the dead socket."""
-    probe = ObsHTTPServer(port=0).start()
-    free_port = probe.port
-    probe.stop()
+    free_port = reserve_port()
     monkeypatch.setenv("BAGUA_OBS_HTTP_PORT", str(free_port))
     monkeypatch.setattr(obs_http, "_GLOBAL_SERVER", None)
     try:

@@ -1,0 +1,294 @@
+"""Pod simulator unit layer — the no-subprocess tests.
+
+Covers the shaping maths (deterministic jitter, transfer time, ICI/DCN
+classification pinned to the communicator's constants), fault-plan
+composition through the ``podsim.link`` point, the wire codecs and ring
+collectives (exercised over in-memory queue rings — byte-identical
+frames to the TCP transport, no sockets), and the port-reservation
+utility the multi-process tests and drills share.  The subprocess proof
+itself lives in ``scripts/scale_drill.py`` (CI runs ``--smoke``).
+"""
+
+import json
+import queue
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from bagua_tpu.faults.inject import FaultSpec, fault_scope
+from bagua_tpu.podsim import collectives as C
+from bagua_tpu.podsim.shaping import (
+    LINK_DCN,
+    LINK_ICI,
+    SHAPE_PRESETS,
+    LinkDropped,
+    LinkSevered,
+    LinkShaper,
+    LinkSpec,
+    ShapeSpec,
+    classify_link,
+    deterministic_jitter,
+    resolve_shape,
+    transfer_time_s,
+)
+from bagua_tpu.podsim.util import reserve_port, reserve_ports
+
+# ---- link classification --------------------------------------------------
+
+
+def test_link_class_constants_match_communicator():
+    """The simulator re-declares the link-class literals to stay jax-free;
+    they must never drift from the communicator's."""
+    from bagua_tpu import communication as comm
+
+    assert LINK_ICI == comm.LINK_ICI
+    assert LINK_DCN == comm.LINK_DCN
+
+
+def test_classify_link_contiguous_slices():
+    # slice_size=4: ranks 0-3 share a slice, 4-7 the next
+    assert classify_link(0, 3, 4) == LINK_ICI
+    assert classify_link(3, 4, 4) == LINK_DCN
+    assert classify_link(4, 7, 4) == LINK_ICI
+    assert classify_link(0, 7, 4) == LINK_DCN
+    assert classify_link(5, 5, 4) == LINK_ICI
+    # degenerate slice sizes never classify DCN
+    assert classify_link(0, 99, 0) == LINK_ICI
+
+
+# ---- shaping maths --------------------------------------------------------
+
+
+def test_deterministic_jitter_is_deterministic_and_uniform_range():
+    u1 = deterministic_jitter(7, 3, 4, 0)
+    assert u1 == deterministic_jitter(7, 3, 4, 0)
+    assert 0.0 <= u1 < 1.0
+    # varies with every identifier
+    assert u1 != deterministic_jitter(8, 3, 4, 0)
+    assert u1 != deterministic_jitter(7, 3, 4, 1)
+
+
+def test_transfer_time_components():
+    link = LinkSpec(latency_s=1e-3, bandwidth_Bps=1e6, jitter_s=2e-3)
+    # latency + serialization + u * jitter
+    assert transfer_time_s(1000, link, u=0.5) == pytest.approx(
+        1e-3 + 1000 / 1e6 + 0.5 * 2e-3)
+    # zero bandwidth = infinite (no serialization term)
+    assert transfer_time_s(10**9, LinkSpec(latency_s=1e-3), u=0.0) \
+        == pytest.approx(1e-3)
+
+
+def test_shaper_delay_asymmetry_and_stats():
+    shape = resolve_shape("pod", slice_size=4, seed=0)
+    slept = []
+    shaper = LinkShaper(shape, 8, sleep=slept.append)
+    d_ici = shaper.traverse(0, 1, 10**6, hop=0)
+    d_dcn = shaper.traverse(3, 4, 10**6, hop=0)
+    # the whole point: the DCN tier is orders slower per byte
+    assert d_dcn > d_ici * 10
+    assert slept == [d_ici, d_dcn]
+    assert shaper.stats[LINK_ICI] == {
+        "hops": 1, "bytes": 10**6, "slept_s": d_ici}
+    assert shaper.stats[LINK_DCN]["hops"] == 1
+    # replays identically (deterministic jitter, pure maths)
+    assert shaper.delay_s(0, 1, 10**6, hop=0) == d_ici
+
+
+def test_resolve_shape_presets_json_dict_and_overrides():
+    assert resolve_shape(None).name == "off"
+    assert resolve_shape("").name == "off"
+    assert resolve_shape("wan") is SHAPE_PRESETS["wan"]
+    spec = resolve_shape("pod", slice_size=16, seed=9)
+    assert (spec.slice_size, spec.seed) == (16, 9)
+    assert spec.ici == SHAPE_PRESETS["pod"].ici
+    from_json = resolve_shape(json.dumps(
+        {"name": "x", "slice_size": 2, "dcn": {"latency_s": 0.5}}))
+    assert from_json.dcn.latency_s == 0.5 and from_json.ici.latency_s == 0.0
+    assert resolve_shape({"name": "y"}).name == "y"
+    passthrough = ShapeSpec(name="z")
+    assert resolve_shape(passthrough) is passthrough
+    with pytest.raises(ValueError, match="unknown link shape"):
+        resolve_shape("no-such-preset")
+
+
+# ---- fault composition ----------------------------------------------------
+
+
+def test_link_drop_fault_fires_as_connection_error():
+    shaper = LinkShaper(resolve_shape("off"), 8)
+    with fault_scope(FaultSpec("podsim.link", kind="drop", count=1)):
+        with pytest.raises(LinkDropped):
+            shaper.traverse(0, 1, 100)
+        # count=1: the next hop sails through
+        shaper.traverse(0, 1, 100)
+    assert issubclass(LinkDropped, ConnectionError)
+
+
+def test_link_partition_severs_dcn_not_ici_until_expiry():
+    clock = [100.0]
+    shape = resolve_shape("off", slice_size=4)
+    shaper = LinkShaper(shape, 8, sleep=lambda s: None,
+                        clock=lambda: clock[0])
+    # partition slice 1 (ranks 4-7) for 5 simulated seconds
+    with fault_scope(FaultSpec("podsim.link", kind="partition", rank=1,
+                               duration_s=5.0, count=1)):
+        with pytest.raises(LinkSevered):
+            shaper.traverse(3, 4, 100)  # DCN hop into the cut slice
+    # the cut OUTLIVES the armed plan (physics, not bookkeeping):
+    with pytest.raises(LinkSevered):
+        shaper.traverse(7, 0, 100)      # DCN hop out of the cut slice
+    shaper.traverse(4, 5, 100)          # ICI inside the cut slice: fine
+    shaper.traverse(0, 1, 100)          # ICI elsewhere: fine
+    clock[0] += 6.0                     # cut expires
+    shaper.traverse(3, 4, 100)
+
+
+# ---- wire codecs ----------------------------------------------------------
+
+
+def test_codec_f32_roundtrip_exact():
+    x = np.linspace(-3, 3, 101, dtype=np.float32)
+    idx, y = C.decode_chunk(C.encode_chunk(5, x, "f32"))
+    assert idx == 5
+    np.testing.assert_array_equal(x, y)
+
+
+def test_codec_minmax_uint8_error_bound_and_wire_bytes():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-2, 2, 4096).astype(np.float32)
+    idx, y = C.decode_chunk(C.encode_chunk(0, x, "minmax_uint8"))
+    span = float(x.max() - x.min())
+    assert float(np.max(np.abs(x - y))) <= span / 255.0 * 0.5 + 1e-6
+    # the DCN tier's 4x: u8 payload + fixed header/sidecar
+    assert C.wire_bytes(4096, "minmax_uint8") == 5 + 8 + 4096
+    assert C.wire_bytes(4096, "f32") == 5 + 4 * 4096
+    # constant chunk degenerates safely (hi == lo)
+    idx, y = C.decode_chunk(C.encode_chunk(1, np.full(8, 2.5), "minmax_uint8"))
+    np.testing.assert_allclose(y, 2.5, atol=1e-6)
+
+
+# ---- ring collectives over in-memory rings --------------------------------
+
+
+class _MemRing:
+    """Queue-backed stand-in for RingTransport: position p's hop pushes
+    to p+1's inbox and pops its own — the same frame bytes, no sockets."""
+
+    def __init__(self, size):
+        self.size = size
+        self._inboxes = [queue.Queue() for _ in range(size)]
+
+    def hop_fn(self, pos):
+        if self.size == 1:
+            return lambda payload, hop_index=0: payload
+
+        def hop(payload, hop_index=0):
+            self._inboxes[(pos + 1) % self.size].put(payload)
+            return self._inboxes[pos].get(timeout=30)
+
+        return hop
+
+
+def _run_world(world, fn):
+    """fn(rank) on one thread per rank; returns results, re-raising the
+    first worker error."""
+    results, errors = [None] * world, []
+
+    def run(rank):
+        try:
+            results[rank] = fn(rank)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    if errors:
+        raise errors[0][1]
+    return results
+
+
+def test_flat_ring_allreduce_f32_exact():
+    world, n = 4, 103  # deliberately not divisible by world
+    vecs = [np.random.default_rng([1, r]).uniform(-1, 1, n)
+            .astype(np.float32) for r in range(world)]
+    expected = np.sum(vecs, axis=0)
+    ring = _MemRing(world)
+
+    def run(rank):
+        out, hops = C.ring_allreduce(
+            vecs[rank], rank, world, ring.hop_fn(rank), codec="f32")
+        assert hops == 2 * (world - 1)
+        return out
+
+    for out in _run_world(world, run):
+        np.testing.assert_allclose(out, expected, rtol=1e-6, atol=1e-5)
+
+
+def test_hierarchical_allreduce_compressed_dcn_within_tolerance():
+    intra, inter, n = 4, 2, 512
+    world = intra * inter
+    vecs = [np.random.default_rng([2, r]).uniform(-1, 1, n)
+            .astype(np.float32) for r in range(world)]
+    expected = np.mean(vecs, axis=0)
+    intra_rings = [_MemRing(intra) for _ in range(inter)]
+    inter_rings = [_MemRing(inter) for _ in range(intra)]
+
+    def run(rank):
+        s, p = rank // intra, rank % intra
+        out, hops = C.hierarchical_allreduce(
+            vecs[rank],
+            intra_rings[s].hop_fn(p), p, intra,
+            inter_rings[p].hop_fn(s), s, inter,
+            dcn_codec="minmax_uint8",
+        )
+        assert hops == {"intra_hops": 2 * (intra - 1),
+                        "inter_hops": 2 * (inter - 1), "world": world}
+        return out
+
+    atol = C.quantization_atol(2.0 * intra, 2 * (inter - 1))
+    for out in _run_world(world, run):
+        assert float(np.max(np.abs(out - expected))) <= atol
+        # and the compression must actually cost SOMETHING measurable —
+        # a bound so loose it never binds would prove nothing
+        assert float(np.max(np.abs(out - expected))) > 0.0
+
+
+def test_hierarchical_allreduce_f32_everywhere_is_exact():
+    intra, inter, n = 2, 2, 64
+    world = intra * inter
+    vecs = [np.random.default_rng([3, r]).uniform(-1, 1, n)
+            .astype(np.float32) for r in range(world)]
+    expected = np.mean(vecs, axis=0)
+    intra_rings = [_MemRing(intra) for _ in range(inter)]
+    inter_rings = [_MemRing(inter) for _ in range(intra)]
+
+    def run(rank):
+        s, p = rank // intra, rank % intra
+        out, _ = C.hierarchical_allreduce(
+            vecs[rank], intra_rings[s].hop_fn(p), p, intra,
+            inter_rings[p].hop_fn(s), s, inter, dcn_codec="f32")
+        return out
+
+    for out in _run_world(world, run):
+        np.testing.assert_allclose(out, expected, rtol=1e-6, atol=1e-6)
+
+
+# ---- port reservation -----------------------------------------------------
+
+
+def test_reserve_port_unique_and_bindable():
+    ports = reserve_ports(8)
+    assert len(set(ports)) == 8
+    # every reserved port is immediately bindable
+    for port in ports:
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", port))
+    # the process-global ledger never re-issues across calls
+    again = reserve_ports(8)
+    assert not set(ports) & set(again)
