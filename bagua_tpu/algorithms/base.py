@@ -35,6 +35,12 @@ logger = logging.getLogger(__name__)
 #: warning fires at trace time, once per topology, not once per bucket)
 _CODEC_DROP_WARNED: set = set()
 
+#: (family, codec, reason) triples a stateless-EF-codec warning was already
+#: logged for — an error-feedback codec riding the wire WITHOUT its residual
+#: is a deliberate honesty control (BAGUA_EF_RESIDUAL=off) or an unsupported
+#: family, and either way the run should say so exactly once
+_EF_STATELESS_WARNED: set = set()
+
 from ..bucket import BucketPlan
 from ..communication import BaguaCommunicator, ReduceOp
 from ..define import TensorDeclaration
@@ -79,6 +85,12 @@ class AlgorithmContext:
     #: name forces that codec for every family riding the tier.
     intra_codec: Optional[str] = None
     inter_codec: Optional[str] = None
+    #: error-feedback residual machinery allowed on this mesh/trainer:
+    #: the trainer clears it on meshes whose state layout cannot carry
+    #: the per-bucket residual (expert/sharded axes, stacked families) and
+    #: when ``BAGUA_EF_RESIDUAL=off`` (the stateless honesty control).
+    #: :meth:`Algorithm.ef_codec` gates on it.
+    ef_enabled: bool = False
 
     def codec_for(self, link_class: str, family_default=None):
         """Resolve the wire codec for one link class: the tier's policy
@@ -545,6 +557,15 @@ class Algorithm:
     #: compressed scatter-gather).  None = full precision.
     wire_codec_dcn: Optional[str] = None
     wire_codec_flat: Optional[str] = None
+    #: Error-feedback state contract: True when the family's gradient comm
+    #: is the per-bucket flat reduction of :meth:`process_grads_bucketed` /
+    #: :meth:`reduce_bucket_grad`, so a per-bucket fp32 residual flat can
+    #: ride ``algo_state`` and :meth:`compensate_flats` can fold it into the
+    #: buckets before they hit the wire.  Families whose comm is not a
+    #: bucket map (gossip exchanges, QAdam's momentum pipeline, ZeRO's
+    #: scatter/gather ownership) keep False — an error-feedback codec forced
+    #: onto them rides STATELESS with a loud once-per-run warning.
+    supports_ef_state: bool = False
     #: Gradient-health sentinel contract: True when the family's POST-comm
     #: gradient representation is bitwise-identical on every rank (a plain
     #: summed/averaged bucket reduce), so the per-bucket ``isfinite``
@@ -589,8 +610,125 @@ class Algorithm:
     # ---- traced stages --------------------------------------------------
 
     def init_state(self, ctx: AlgorithmContext, params) -> Any:
-        """Create algorithm state (peer-weight replicas, momenta, ...)."""
+        """Create algorithm state (peer-weight replicas, momenta, ...).
+        The base state is the error-feedback residual container when an EF
+        codec is active under this context, else None."""
+        return self.ef_init_state(ctx, None)
+
+    # ---- error-feedback residual (stateful codecs) -----------------------
+    #
+    # The 1-bit and top-k codecs are BIASED quantizers: their per-step error
+    # does not average out, so SGD on their raw output diverges.  Error
+    # feedback (EF-SignSGD, arXiv:1901.09847; 1-bit Adam, arXiv:2102.02888)
+    # restores convergence by carrying the quantization error forward: each
+    # step compresses ``grad + residual`` and keeps the part the wire lost.
+    # The residual lives in ``algo_state["ef"]["buckets"]`` as one fp32 flat
+    # per bucket ([1, padded_numel] per shard, stacked [world, padded_numel]
+    # globally) so it rides the existing state machinery: grad-guard skips
+    # rewind it with the step, rebuckets migrate it through
+    # ``relayout_flats``, and checkpoints carry it with a layout sidecar.
+    #
+    # One local encode/decode roundtrip per bucket models the wire error.
+    # The ring's per-hop re-quantization of PARTIAL sums is not captured —
+    # the residual compensates the dominant (input quantization) error term,
+    # which is the published algorithms' formulation too; the hop error
+    # shrinks with chunk count and accumulates in fp32.
+
+    def ef_codec(self, ctx: AlgorithmContext):
+        """The error-feedback codec whose residual this family accumulates
+        under the ACTIVE config, or None.  Resolution mirrors what the
+        wire actually carries: the DCN then ICI tier codecs on the
+        hierarchical two-tier path, the flat ring codec otherwise (skipped
+        for scatter-gather families with their own flat pipeline — a
+        forced codec NAME never engages there, so neither may EF).  An EF
+        codec that resolves on an unsupported family, or with the residual
+        disabled (``BAGUA_EF_RESIDUAL=off`` — the honesty control), rides
+        STATELESS with a once-per-run warning."""
+        from ..communication import LINK_DCN, LINK_ICI
+        from ..compression.codecs import get_codec
+
+        names: List = []
+        if getattr(self, "hierarchical", False) and ctx.two_tier():
+            names.append(ctx.codec_for(LINK_DCN, self.wire_codec_dcn))
+            names.append(ctx.codec_for(LINK_ICI, None))
+        elif self.wire_codec_flat is None:
+            names.append(ctx.flat_ring_codec(warn=False))
+        codec = None
+        for name in names:
+            if name is None:
+                continue
+            c = get_codec(name)
+            if getattr(c, "error_feedback", False):
+                codec = c
+                break
+        if codec is None:
+            return None
+        if self.supports_ef_state and ctx.ef_enabled:
+            return codec
+        reason = ("unsupported_family" if not self.supports_ef_state
+                  else "residual_disabled")
+        key = (type(self).__name__, codec.name, reason)
+        if key not in _EF_STATELESS_WARNED:
+            _EF_STATELESS_WARNED.add(key)
+            logger.warning(
+                "codec %r is an error-feedback codec but its residual is "
+                "OFF (%s) for %s: the wire carries raw %s output, whose "
+                "quantization bias is known to stall/diverge SGD — only "
+                "use this as a convergence control",
+                codec.name, reason, type(self).__name__, codec.name,
+            )
         return None
+
+    def ef_init_state(self, ctx: AlgorithmContext, state: Any) -> Any:
+        """Merge the error-feedback residual container into ``state``
+        (traced, per shard): one zero fp32 flat per bucket — this shard's
+        ``[1, padded_numel]`` row of the stacked ``[world, padded_numel]``
+        global.  Identity when no EF codec is active, so families that
+        build their own state just wrap it through here."""
+        if self.ef_codec(ctx) is None:
+            return state
+        ef = {"buckets": tuple(
+            jnp.zeros((1, b.padded_numel), jnp.float32)
+            for b in ctx.plan.buckets
+        )}
+        if state is None:
+            return {"ef": ef}
+        assert isinstance(state, dict) and "ef" not in state, state
+        return {**state, "ef": ef}
+
+    def algo_state_specs(self, ctx: AlgorithmContext, default, stacked):
+        """shard_map partition specs (pytree prefixes) for this family's
+        algo state: ``default`` is the trainer's replicated spec,
+        ``stacked`` its per-rank stacked-leading-axis spec — which is what
+        the EF residual's ``[world, padded_numel]`` buckets ride."""
+        if self.ef_codec(ctx) is None:
+            return default
+        return {"ef": stacked}
+
+    def compensate_flats(self, ctx: AlgorithmContext, flats, algo_state):
+        """Fold the per-bucket error-feedback residual into the bucket
+        flats about to hit the wire and accumulate the new quantization
+        error: ``c = grad + r``; the wire carries ``encode(c)``; ``r' =
+        c - decode(encode(c))``.  Identity (no traced ops at all) when no
+        EF codec is active — the compiled step with compression off is
+        byte-identical to one without this hook."""
+        codec = self.ef_codec(ctx)
+        if codec is None:
+            return flats, algo_state
+        ef = algo_state.get("ef") if isinstance(algo_state, dict) else None
+        if ef is None:
+            # state predates the codec flip; the trainer's knob-sync
+            # migration adds the container before the next compiled step
+            return flats, algo_state
+        out, residuals = [], []
+        for flat, res in zip(flats, ef["buckets"]):
+            c = flat.astype(jnp.float32) + res[0]
+            dec = codec.decode(codec.encode(c[None, :]), c.shape[0])[0]
+            residuals.append((c - dec)[None, :])
+            out.append(c.astype(flat.dtype))
+        new_state = dict(algo_state)
+        new_state["ef"] = {"buckets": tuple(residuals)}
+        return out, new_state
 
     def process_grads(self, ctx: AlgorithmContext, grads, params, algo_state, step):
         """Gradient communication stage (runs where the reference's backward
@@ -632,6 +770,7 @@ class Algorithm:
         (DCN-dominant buckets first on hierarchical two-tier meshes under
         the overlap scheduler); results assemble in plan order."""
         flats = ctx.bucket_flats(grads)
+        flats, algo_state = self.compensate_flats(ctx, flats, algo_state)
         order = ctx.bucket_launch_order(getattr(self, "hierarchical", False),
                                         dcn_codec=self.wire_codec_dcn)
         reduced: List = [None] * len(flats)
@@ -650,6 +789,17 @@ class Algorithm:
         state needs no migration."""
         if algo_state is None:
             return None
+        if isinstance(algo_state, dict) and set(algo_state) == {"ef"}:
+            from ..bucket import relayout_flats
+
+            flats = relayout_flats(old_plan, new_plan,
+                                   list(algo_state["ef"]["buckets"]))
+            # the residual is fp32 regardless of the bucket dtype the
+            # relayout cast its segments through (exact for fp32 plans;
+            # sub-fp32 plans round the carried error once per rebucket)
+            return {"ef": {"buckets": tuple(
+                f.astype(jnp.float32) for f in flats
+            )}}
         raise NotImplementedError(
             f"{type(self).__name__} carries algorithm state but does not "
             "implement relayout_algo_state; re-bucketing its flat-resident "

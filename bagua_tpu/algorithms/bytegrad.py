@@ -37,6 +37,11 @@ class ByteGradAlgorithm(Algorithm):
     #: non-hierarchical path wire format (the compressed scatter-gather):
     #: the byte-accounting default for ``bucket_tier_bytes``
     wire_codec_flat = "minmax_uint8"
+    #: the hierarchical DCN stage can carry an error-feedback residual when
+    #: ``BAGUA_COMPRESS_INTER`` escalates the ring to a stateful codec
+    #: (onebit_ef / topk); the flat scatter-gather pipeline never does —
+    #: it has one wire format (minmax_uint8)
+    supports_ef_state = True
 
     def __init__(self, hierarchical: bool = True, average: bool = True,
                  codec: str = "minmax_uint8"):

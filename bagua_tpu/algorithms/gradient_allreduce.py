@@ -27,6 +27,10 @@ class GradientAllReduceAlgorithm(Algorithm):
     #: contribution from any rank survives into every rank's copy), so the
     #: gradient-health sentinel rides them with no extra collective
     grad_health_replicated = True
+    #: the per-bucket flat reduction can carry an error-feedback residual
+    #: when the codec policy forces a stateful codec (onebit_ef / topk)
+    #: onto its rings
+    supports_ef_state = True
 
     def __init__(
         self,

@@ -62,11 +62,19 @@ COLLECTIVE_PRIMS = {
 #: traces the identical program — the spelling stays supported for
 #: ad-hoc CLI runs but is not swept twice); the forced int8/fp8 configs
 #: cover the knob-forced path on the exact family.
+#: ``hier-onebit_ef`` (ISSUE 17) sweeps the STATEFUL bit-packed codec:
+#: the step threads the error-feedback residual through algo_state, so
+#: multiset equality additionally proves the residual plumbing emits no
+#: mode-dependent collectives.  (topk is not swept by default: its kk<=2
+#: f32 value arrays on tiny test buckets collide with the sidecar
+#: heuristic in ``_bucket_accounting`` — run it ad hoc via the CLI.)
 DEFAULT_FAMILIES = ("gradient_allreduce", "zero", "bytegrad",
                     "gradient_allreduce:hier", "zero:hier", "bytegrad:hier",
                     "gradient_allreduce:hier-int8",
                     "gradient_allreduce:hier-fp8_e4m3",
-                    "gradient_allreduce:hier-fp8_e5m2")
+                    "gradient_allreduce:hier-fp8_e5m2",
+                    "gradient_allreduce:hier-onebit_ef",
+                    "bytegrad:hier-onebit_ef")
 DEFAULT_ACCUM_STEPS = (1, 4)
 
 
@@ -235,6 +243,35 @@ def diff_multisets(a: Counter, b: Counter) -> str:
     return "\n".join(lines)
 
 
+def _candidate_codecs(trainer):
+    """The VARIABLE-PAYLOAD codecs this trainer could put on a wire —
+    resolved from the per-link-class knobs and the algorithm's family
+    defaults.  Uniform codecs (u8/int8/fp8: one payload element per input
+    element) are excluded: their hop numels already sit in the
+    full-precision size set."""
+    from ..compression.codecs import get_codec
+
+    names = set()
+    for knob in (getattr(trainer, "compress_intra", None),
+                 getattr(trainer, "compress_inter", None)):
+        if knob not in (None, "auto", "off"):
+            names.add(knob)
+    algo = getattr(trainer, "algorithm", None)
+    for attr in ("wire_codec_dcn", "wire_codec_flat"):
+        name = getattr(algo, attr, None)
+        if name:
+            names.add(name)
+    out = []
+    for name in sorted(names):
+        try:
+            codec = get_codec(name)
+        except Exception:
+            continue
+        if getattr(codec, "variable_payload", False):
+            out.append(codec)
+    return out
+
+
 def _bucket_accounting(trainer, collectives: Sequence[Collective]) -> List[dict]:
     """Per-bucket byte accounting: which collectives carried each bucket's
     flat buffer (full-flat or 1/world chunk payloads, by numel match).
@@ -267,6 +304,13 @@ def _bucket_accounting(trainer, collectives: Sequence[Collective]) -> List[dict]
             shard = p2 // ni
             pe = -(-shard // ne) * ne
             sizes.update({pe, pe // ne})
+        # variable-payload codecs (onebit_ef's lane-padded bit-pack, topk's
+        # index/value pairs): the traced hop operand's numel is a FUNCTION
+        # of the chunk numel, not equal to it — fold every candidate
+        # codec's payload_numel of every full-precision size into the
+        # match key so attribution stays honest when the wire is sparse.
+        for codec in _candidate_codecs(trainer):
+            sizes.update(codec.payload_numel(s) for s in tuple(sizes))
         return tuple(sorted(sizes))
 
     buckets = list(trainer._plan.buckets)

@@ -153,6 +153,13 @@ class PolicyState:
     actions_taken: int = 0
     #: SLO ladder rung reached (0 = healthy; index into LADDER is rung-1)
     rung: int = 0
+    #: codec-ladder rung for the compress_dcn hint (0 = the configured
+    #: start codec): each sustained RE-breach of DCN dominance after an
+    #: actuated hint escalates one rung along
+    #: ``bagua_tpu.compression.codecs.CODEC_LADDER`` (uint8 -> fp8 ->
+    #: onebit_ef -> topk) — more aggressive wire formats until the DCN
+    #: share drops below the threshold; unwinds when dominance clears
+    codec_rung: int = 0
     #: consecutive healthy (non-breaching) snapshots — de-escalation timer
     slo_clear_streak: int = 0
     #: storage paths already quarantined (idempotence)
@@ -440,9 +447,28 @@ def decide(snapshot: dict, state: PolicyState, config: PolicyConfig,
         and (it["trends"].get("dcn_comm_share") or 0.0) >= config.dcn_share
     ]
     streak = _bump_streak(state, "dcn", bool(dcn_items))
+    if not dcn_items and state.codec_rung:
+        # dominance cleared: the current codec relieved the slow tier —
+        # unwind the ladder so a later breach re-climbs from the start
+        state.codec_rung = 0
     if dcn_items and streak >= config.sustain:
         why = _gate(state, config, "compress_dcn", now)
         if why is None:
+            # codec ladder: the FIRST hint actuates the configured start
+            # codec; every sustained re-breach afterwards (the actuated
+            # codec did not relieve the DCN share) escalates one rung —
+            # uint8 -> fp8 -> onebit_ef -> topk, ~4x to 16-32x wire
+            # reduction.  A start codec outside the ladder stays fixed
+            # (the operator chose a specific format).
+            from ..compression.codecs import CODEC_LADDER
+            if config.compress_codec in CODEC_LADDER:
+                base = CODEC_LADDER.index(config.compress_codec)
+                idx = min(base + state.codec_rung, len(CODEC_LADDER) - 1)
+                codec = CODEC_LADDER[idx]
+                state.codec_rung = min(state.codec_rung + 1,
+                                       len(CODEC_LADDER) - 1 - base)
+            else:
+                codec = config.compress_codec
             shares = {it["rank"]: round(
                 it["trends"]["dcn_comm_share"], 3) for it in dcn_items}
             _emit(state, actions, Action(
@@ -453,10 +479,11 @@ def decide(snapshot: dict, state: PolicyState, config: PolicyConfig,
                         f"DCN tier (shares {shares}) sustained {streak} "
                         f"snapshots; hinting compression family "
                         f"{config.compress_family!r} and actuating DCN "
-                        f"codec {config.compress_codec!r} for the slow "
-                        "tier"),
+                        f"codec {codec!r} for the slow tier "
+                        f"(ladder rung {state.codec_rung})"),
                 evidence={"trends": dcn_items, "streak": streak,
-                          "codec": config.compress_codec},
+                          "codec": codec,
+                          "codec_rung": state.codec_rung},
             ), now)
             state.streaks.pop("dcn", None)
 

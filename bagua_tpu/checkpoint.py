@@ -480,8 +480,12 @@ class BaguaCheckpointManager:
 
     #: metadata keys that carry layout PAYLOAD (the full bucket layout
     #: descriptor) or side-channel records (the integrity digest), not
-    #: compatibility constraints — never compared
-    _LAYOUT_PAYLOAD_KEYS = ("flat_layout", "stacked", "integrity")
+    #: compatibility constraints — never compared.  "ef" (the
+    #: error-feedback residual's plan/world descriptor) is payload too:
+    #: BaguaTrainer.restore_checkpoint adapts on it explicitly (relayout /
+    #: zero-reset), so a residual difference must not fail the strict
+    #: comparison that guards the rest of the state
+    _LAYOUT_PAYLOAD_KEYS = ("flat_layout", "stacked", "integrity", "ef")
 
     @classmethod
     def _normalize_layout(cls, meta: Optional[dict]) -> Optional[dict]:

@@ -295,10 +295,14 @@ class BaguaCommunicator:
                 buf = buf / n
             return buf
         buf = block(r - 1).astype(jnp.float32)
+        m = buf.shape[0]
         for s in range(n - 1):
             parts = codec.encode(buf[None])
             parts = tuple(self.ppermute(p, perm) for p in parts)
-            buf = codec.decode(parts)[0] + block(r - 2 - s).astype(jnp.float32)
+            # m is explicit: the bit-packed/variable-payload codecs cannot
+            # invert payload shape -> element count
+            buf = codec.decode(parts, m)[0] \
+                + block(r - 2 - s).astype(jnp.float32)
         if op == ReduceOp.AVG:
             buf = buf / n
         return buf
@@ -336,7 +340,7 @@ class BaguaCommunicator:
                                                 axis=0)
                 for o, c in zip(stacked, cur)
             ]
-        return codec.decode(tuple(stacked)).reshape(-1)
+        return codec.decode(tuple(stacked), x.shape[0]).reshape(-1)
 
     def _ring_chunk_views(self, x, num_chunks: int, n: int):
         """Split flat ``x`` into ``num_chunks`` independent sub-buffers such

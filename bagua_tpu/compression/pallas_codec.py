@@ -326,6 +326,191 @@ def absmax_chunked_pallas(
     return stats.reshape(n_chunks, _STATS_ROWS, _LANE)[:, 0, 0]
 
 
+# ---- 1-bit sign codec (ISSUE 17) ----------------------------------------
+#
+# Wire layout (shared with the jnp fallback in codecs.OneBitEfCodec — the
+# two paths are byte-identical, so a chunk packed here decodes through
+# either): a chunk of m elements packs into B = ceil(m/1024)*128 bytes,
+# bit-PLANAR over 8 sublane groups — byte j carries bit b = sign of flat
+# element b*B*8/8... precisely: with the padded chunk viewed as
+# [8*br, 128] rows (br = B/128), bit b of payload row r comes from input
+# row b*br + r.  Planar packing keeps both pack and unpack pure
+# shift+or over CONTIGUOUS sublane slices — no lane-crossing relayouts.
+
+
+def _sign_rows(chunk: int) -> int:
+    """Padded f32 rows of one chunk for the sign codec: a multiple of 8
+    so the 8 bit planes are whole sublane slices."""
+    return 8 * (-(-chunk // (8 * _LANE)))
+
+
+def _sign_pack_kernel(x_ref, stats_ref, payload_ref, *, chunk: int):
+    """Fused mean-abs reduction + planar sign pack, one VMEM pass.  The
+    scale rides the shared stats-block layout (row 0, lane 0); padding
+    lanes pack arbitrary sign bits that decode slices off."""
+    x = x_ref[:].astype(jnp.float32)
+    rows, lanes = x.shape
+    flat_idx = (
+        jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) * lanes
+        + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    )
+    mask = flat_idx < chunk
+    scale = jnp.sum(jnp.where(mask, jnp.abs(x), 0.0)) / chunk
+    stats_ref[:] = jnp.full((_STATS_ROWS, _LANE), scale, jnp.float32)
+    bits = (x >= 0).astype(jnp.int32)
+    br = rows // 8
+    packed = bits[0:br, :]
+    for b in range(1, 8):
+        packed = packed | (bits[b * br:(b + 1) * br, :] << b)
+    payload_ref[:] = packed.astype(jnp.uint8)
+
+
+def _sumabs_tile_kernel(x_ref, stats_ref, *, chunk: int):
+    """Tiled mean-abs accumulation past the fused VMEM ceiling (the
+    ``_absmax_tile_kernel`` pattern); the pack itself is elementwise and
+    stays on the XLA lowering at those sizes (module docstring)."""
+    j = pl.program_id(1)
+    x = x_ref[:].astype(jnp.float32)
+    rows, lanes = x.shape
+    base = j * rows * lanes
+    flat_idx = (
+        base
+        + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) * lanes
+        + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    )
+    mask = flat_idx < chunk
+    s = jnp.sum(jnp.where(mask, jnp.abs(x), 0.0)) / chunk
+    tile_stats = jnp.full((_STATS_ROWS, _LANE), s, jnp.float32)
+
+    @pl.when(j == 0)
+    def _init():
+        stats_ref[:] = tile_stats
+
+    @pl.when(j > 0)
+    def _accum():
+        stats_ref[:] = stats_ref[:] + tile_stats
+
+
+def _jnp_sign_pack(x2d: jax.Array) -> jax.Array:
+    """Planar pack on the XLA lowering — the byte-identical fallback (and
+    the pack half of the tiled path)."""
+    k, m = x2d.shape
+    rows = _sign_rows(m)
+    br = rows // 8
+    xp = jnp.pad(x2d, ((0, 0), (0, rows * _LANE - m)))
+    bits = (xp >= 0).reshape(k, 8, br * _LANE).astype(jnp.uint8)
+    packed = bits[:, 0, :]
+    for b in range(1, 8):
+        packed = packed | (bits[:, b, :] << b)
+    return packed
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def sign_compress_chunked_pallas(
+    x: jax.Array, n_chunks: int, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-chunk (mean-abs scale, planar-packed sign bits) of flat ``x``
+    (``size % n_chunks == 0``).  Fused one-pass inside the VMEM ceiling;
+    past it the reduction tiles and the pack rides XLA."""
+    assert x.size % n_chunks == 0, (x.size, n_chunks)
+    chunk = x.size // n_chunks
+    rows = _sign_rows(chunk)
+    x2d = x.reshape(n_chunks, chunk).astype(jnp.float32)
+    if rows <= _MAX_FUSED_ROWS:
+        br = rows // 8
+        xp = jnp.pad(x2d, ((0, 0), (0, rows * _LANE - chunk))).reshape(
+            n_chunks * rows, _LANE
+        )
+        stats, payload = pl.pallas_call(
+            functools.partial(_sign_pack_kernel, chunk=chunk),
+            grid=(n_chunks,),
+            in_specs=[
+                pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((_STATS_ROWS, _LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((br, _LANE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n_chunks * _STATS_ROWS, _LANE),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((n_chunks * br, _LANE), jnp.uint8),
+            ],
+            interpret=interpret,
+        )(xp)
+        scale = stats.reshape(n_chunks, _STATS_ROWS, _LANE)[:, 0, 0]
+        return scale, payload.reshape(n_chunks, br * _LANE)
+    # tiled reduction + XLA pack
+    trows = -(-rows // _TILE_ROWS) * _TILE_ROWS
+    n_tiles = trows // _TILE_ROWS
+    xp = jnp.pad(x2d, ((0, 0), (0, trows * _LANE - chunk))).reshape(
+        n_chunks * trows, _LANE
+    )
+    stats = pl.pallas_call(
+        functools.partial(_sumabs_tile_kernel, chunk=chunk),
+        grid=(n_chunks, n_tiles),
+        in_specs=[
+            pl.BlockSpec((_TILE_ROWS, _LANE),
+                         lambda i, j: (i * n_tiles + j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_STATS_ROWS, _LANE), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_chunks * _STATS_ROWS, _LANE), jnp.float32
+        ),
+        interpret=interpret,
+    )(xp)
+    scale = stats.reshape(n_chunks, _STATS_ROWS, _LANE)[:, 0, 0]
+    return scale, _jnp_sign_pack(x2d)
+
+
+def _sign_unpack_kernel(stats_ref, payload_ref, out_ref):
+    """Planar sign unpack: the inverse sublane layout, scaled by the
+    chunk's mean-abs (a NaN/Inf scale poisons the whole chunk — the
+    grad-guard propagation contract)."""
+    scale = stats_ref[0, 0]
+    p = payload_ref[:].astype(jnp.int32)
+    planes = [((p >> b) & 1).astype(jnp.float32) for b in range(8)]
+    bits = jnp.concatenate(planes, axis=0)
+    out_ref[:] = (bits * 2.0 - 1.0) * scale
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def sign_decompress_chunked_pallas(
+    scale: jax.Array, payload: jax.Array, interpret: bool = False
+) -> jax.Array:
+    """Inverse of :func:`sign_compress_chunked_pallas`; returns the
+    PADDED [n_chunks, rows*128] f32 block (the codec slices to m).  Only
+    the fused size range routes here — larger chunks unpack through the
+    XLA lowering like every other decompress."""
+    n_chunks, B = payload.shape
+    br = B // _LANE
+    rows = 8 * br
+    pp = payload.reshape(n_chunks * br, _LANE)
+    block = jnp.zeros((n_chunks, _STATS_ROWS, _LANE), jnp.float32)
+    block = block.at[:, 0, 0].set(scale.astype(jnp.float32))
+    out = pl.pallas_call(
+        _sign_unpack_kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((_STATS_ROWS, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, _LANE), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_chunks * rows, _LANE),
+                                       jnp.float32),
+        interpret=interpret,
+    )(block.reshape(n_chunks * _STATS_ROWS, _LANE), pp)
+    return out.reshape(n_chunks, rows * _LANE)
+
+
 @functools.partial(jax.jit, static_argnums=(3,))
 def decompress_chunked_pallas(
     mn: jax.Array, mx: jax.Array, payload: jax.Array, interpret: bool = False

@@ -430,6 +430,18 @@ class BaguaTrainer:
             env.get_compress_inter() if compress_inter is None
             else compress_inter, "compress_inter"
         )
+        #: error-feedback residual machinery allowed here: on unless the
+        #: honesty control (BAGUA_EF_RESIDUAL=off) disables it or the mesh
+        #: carries model-parallel/expert axes (their stacked algo-state
+        #: layouts have no spec mapping for the per-bucket residual).
+        #: Whether a residual is ACTUALLY carried is then the algorithm's
+        #: call (Algorithm.ef_codec: a stateful codec resolved on its wire
+        #: + supports_ef_state).
+        self._ef_enabled = (
+            not env.is_ef_residual_disabled()
+            and self._shard_axis is None
+            and self.expert_axis is None
+        )
         self.flat_resident = (
             flat_resident or env.get_flat_resident_mode()
         ).strip().lower()
@@ -632,6 +644,7 @@ class BaguaTrainer:
             intra_codec=self.compress_intra,
             inter_codec=self.compress_inter,
             flat_resident=self._flat_resident,
+            ef_enabled=self._ef_enabled,
         )
 
     def _flat_supported(self) -> bool:
@@ -889,13 +902,68 @@ class BaguaTrainer:
             decl_buckets, self._named_params, self.world_size
         )
         if (
-            self._flat_resident
+            # the error-feedback residual is plan-keyed algo state even
+            # under the leaf layout, so an active EF codec makes a plan
+            # change a state migration too (relayout_algo_state carries
+            # the residual across the new bucket boundaries)
+            (self._flat_resident or self._ef_active())
             and old_plan is not None
             and old_plan.signature() != self._plan.signature()
         ):
             self._queue_state_migration(
                 self._make_flat_migration(old_plan, self._plan)
             )
+
+    def _ef_active(self) -> bool:
+        """Whether the CURRENT configuration carries the error-feedback
+        residual in algo_state (a stateful codec resolved on this family's
+        wire) — plan-keyed state, so rebuckets and codec-knob flips must
+        migrate it."""
+        if self._plan is None:
+            return False
+        return self.algorithm.ef_codec(self._ctx(self._plan)) is not None
+
+    def _sync_ef_state(self, was_active: bool) -> None:
+        """Queue a state migration when a knob change flipped whether the
+        error-feedback residual is carried: newly active starts from zero
+        residuals (the published EF algorithms' init), newly inactive
+        drops the accumulated residual — both loud, both applied before
+        the next compiled step dispatches."""
+        now = self._ef_active()
+        if now == was_active:
+            return
+        plan = self._plan
+        world = self.world_size
+
+        if now:
+            def add_ef(state: TrainState) -> TrainState:
+                if state.algo_state is not None:
+                    return state  # already carried (idempotent re-queue)
+                logger.info(
+                    "error-feedback residual enabled (codec policy flip): "
+                    "starting from zero residuals for %d buckets",
+                    len(plan.buckets),
+                )
+                ef = {"buckets": tuple(
+                    jnp.zeros((world, b.padded_numel), jnp.float32)
+                    for b in plan.buckets
+                )}
+                return state._replace(algo_state={"ef": ef})
+
+            self._queue_state_migration(add_ef)
+        else:
+            def drop_ef(state: TrainState) -> TrainState:
+                a = state.algo_state
+                if not (isinstance(a, dict) and "ef" in a):
+                    return state
+                logger.info(
+                    "error-feedback residual disabled (codec policy "
+                    "flip): dropping the accumulated residual"
+                )
+                rest = {k: v for k, v in a.items() if k != "ef"}
+                return state._replace(algo_state=rest or None)
+
+            self._queue_state_migration(drop_ef)
 
     def _queue_state_migration(self, fn) -> None:
         """Compose ``fn`` onto the pending state migration (earlier-queued
@@ -1112,6 +1180,9 @@ class BaguaTrainer:
             return TrainState(jnp.zeros((), jnp.int32), params, opt_state, algo_state)
 
         if algo.replicated_params:
+            # algo-state specs: replicated by default; the error-feedback
+            # residual's per-bucket flats stack per rank over the comm axes
+            aspecs = algo.algo_state_specs(ctx, P(), P(self.comm_axes))
             if self._flat_resident:
                 # flat-resident replicated layout (allreduce/bytegrad/
                 # qadam): params live as the bucket flats; optimizer state
@@ -1128,7 +1199,7 @@ class BaguaTrainer:
 
                 algo_state = jax.jit(
                     shard_map(init_fn, mesh=mesh, in_specs=(P(),),
-                              out_specs=P(), check_vma=False)
+                              out_specs=aspecs, check_vma=False)
                 )(params)
                 return TrainState(jnp.zeros((), jnp.int32), zparams,
                                   opt_state, algo_state)
@@ -1138,8 +1209,8 @@ class BaguaTrainer:
                 return algo.init_state(ctx, p)
 
             algo_state = jax.jit(
-                shard_map(init_fn, mesh=mesh, in_specs=(P(),), out_specs=P(),
-                          check_vma=False)
+                shard_map(init_fn, mesh=mesh, in_specs=(P(),),
+                          out_specs=aspecs, check_vma=False)
             )(params)
             if self._shard_axis is not None:
                 if algo_state is not None:
@@ -1407,7 +1478,13 @@ class BaguaTrainer:
                     order = ctx.bucket_launch_order(
                         hier, dcn_codec=algo.wire_codec_dcn
                     )
-                    reduced = [None] * len(grads["flats"])
+                    # error-feedback compensation folds the residual into
+                    # the flats BEFORE the streamed collectives (identity
+                    # — zero traced ops — unless a stateful codec rides)
+                    flats, algo_state = algo.compensate_flats(
+                        ctx, list(grads["flats"]), algo_state
+                    )
+                    reduced = [None] * len(flats)
                     for i in order:
                         # tier estimates report COMPRESSED wire bytes when
                         # a codec rides the tier, so the spans (and
@@ -1425,7 +1502,7 @@ class BaguaTrainer:
                             dcn_codec=tiers["dcn_codec"],
                         ):
                             reduced[i] = algo.reduce_bucket_grad(
-                                ctx, i, grads["flats"][i]
+                                ctx, i, flats[i]
                             )
                     grads, algo_state = algo.grads_from_reduced(
                         ctx, reduced, grads, algo_state, step
@@ -1569,8 +1646,14 @@ class BaguaTrainer:
             )
         else:
             pspec = P() if replicated else P(dp)
-            state_specs = TrainState(step=P(), params=pspec, opt_state=pspec,
-                                     algo_state=pspec)
+            # the EF residual (when an error-feedback codec is active) is
+            # the one replicated-family algo state with a per-rank stacked
+            # leading axis; shard_map slices each rank's [1, pad] row
+            state_specs = TrainState(
+                step=P(), params=pspec, opt_state=pspec,
+                algo_state=algo.algo_state_specs(ctx, pspec,
+                                                 P(self.comm_axes)),
+            )
         batch_spec = self._batch_spec()
         self._state_specs = state_specs  # reused by eval_step
 
@@ -1643,6 +1726,12 @@ class BaguaTrainer:
             if self.grad_guard != "off" else "off",
             tuple(s.signature()
                   for s in _inject.armed_traced_specs("grad.poison")),
+            # topk's payload shape (k per chunk) is compiled into the
+            # step from BAGUA_TOPK_RATIO; keying the effective ratio
+            # retraces on an env flip instead of reusing a stale k
+            env.get_topk_ratio()
+            if "topk" in (self.compress_intra, self.compress_inter)
+            else None,
             # compile_key stays LAST: introspection (tests, debugging)
             # reads it as key[-1]
             self.algorithm.compile_key(),
@@ -2453,6 +2542,10 @@ class BaguaTrainer:
             self.autotune = False
 
     def _apply_recommendation(self, recommended) -> None:
+        # snapshot EF-residual activeness: any knob below (family switch,
+        # codec policy, hierarchical toggle) can flip it, and the flip is a
+        # state migration (_sync_ef_state at the end)
+        ef_was = self._ef_active()
         self._maybe_switch_algorithm(recommended)
         # overlap knobs ride the same recommendation path as bucketing so
         # the two compose: a re-bucketed plan keeps the overlap mode, and
@@ -2506,6 +2599,7 @@ class BaguaTrainer:
             and not self.algorithm.sharded_opt_state
         ):
             self.algorithm.hierarchical = bool(recommended.is_hierarchical_reduce)
+        self._sync_ef_state(ef_was)
 
     def _maybe_switch_algorithm(self, recommended) -> None:
         """Swap the algorithm family if the autotuner asked for one
@@ -2911,6 +3005,16 @@ class BaguaTrainer:
                 self._intra.nranks() if self._zero_staged()
                 else self._comm.nranks()
             )
+        if self._ef_active():
+            # the error-feedback residual in algo_state is plan- AND
+            # world-keyed even under the otherwise plan-independent leaf
+            # layout; this sidecar lets restore_checkpoint relayout it
+            # across plans, or zero-reset it across world resizes, instead
+            # of dying on an opaque orbax shape mismatch
+            meta["ef"] = {
+                "world": int(self._comm.nranks()),
+                "flat_layout": self._plan.layout_descriptor(),
+            }
         return meta
 
     # ---- layout-aware checkpointing --------------------------------------
@@ -2991,6 +3095,124 @@ class BaguaTrainer:
 
     def _restore_checkpoint_at(self, manager, state_like: TrainState,
                                step: int):
+        # error-feedback residual adapter: the residual's algo_state slot
+        # is plan- AND world-keyed, so the restore targets the SAVED ef
+        # structure (from the "ef" sidecar) and the fixup converts it into
+        # the live one — relayout across plans, zero-reset across worlds,
+        # zero-init when the checkpoint predates the codec flip, drop (with
+        # a warning) when the live trainer no longer carries a residual
+        saved_meta = manager.read_layout(step)
+        adapted, ef_fixup = self._ef_restore_adapter(state_like, saved_meta)
+        step, restored = self._restore_checkpoint_body(manager, adapted,
+                                                       step)
+        return step, ef_fixup(restored)
+
+    def _ef_restore_adapter(self, state_like: TrainState,
+                            saved: Optional[dict]):
+        """``(adapted_state_like, fixup)`` for the error-feedback residual:
+        ``adapted_state_like`` mirrors the CHECKPOINT's ef presence/shape
+        (so orbax restores structurally), ``fixup`` converts the restored
+        state back to the LIVE layout.  Identity when neither side carries
+        a residual — and when the checkpoint has no sidecar at all, where
+        nothing can be known and the direct restore stays the loud
+        arbiter."""
+        identity = (state_like, lambda s: s)
+        a = state_like.algo_state
+        has_live = isinstance(a, dict) and "ef" in a
+        saved_ef = (saved or {}).get("ef")
+        if saved is None or (not has_live and saved_ef is None):
+            return identity
+        if not has_live and not (isinstance(a, dict) or a is None):
+            # non-dict algo state (stacked families) cannot host a saved
+            # residual slot; the direct restore will surface the mismatch
+            return identity
+
+        ef_plan = None
+        saved_container = None
+        if saved_ef is not None:
+            ef_plan = BucketPlan.from_layout_descriptor(
+                saved_ef["flat_layout"]
+            )
+            saved_container = {"ef": {"buckets": tuple(
+                jax.ShapeDtypeStruct((int(saved_ef["world"]),
+                                      b.padded_numel), np.dtype(np.float32))
+                for b in ef_plan.buckets
+            )}}
+
+        if has_live:
+            rest = {k: v for k, v in a.items() if k != "ef"}
+            adapted_algo = (
+                {**rest, **saved_container} if saved_container is not None
+                else (rest or None)
+            )
+        else:
+            adapted_algo = (
+                {**a, **saved_container} if isinstance(a, dict)
+                else saved_container
+            )
+        live_world = int(self._comm.nranks())
+        live_plan = self._plan
+
+        def fixup(state: TrainState) -> TrainState:
+            a2 = state.algo_state
+            if not has_live:
+                # live trainer carries no residual: drop the restored one
+                if isinstance(a2, dict) and "ef" in a2:
+                    logger.warning(
+                        "restore_checkpoint: discarding the checkpoint's "
+                        "error-feedback residual — no stateful codec is "
+                        "active in this trainer (compress knobs / "
+                        "BAGUA_EF_RESIDUAL).  Re-enable the codec policy "
+                        "before restoring to keep the accumulated error."
+                    )
+                    rest2 = {k: v for k, v in a2.items() if k != "ef"}
+                    return state._replace(algo_state=rest2 or None)
+                return state
+            zeros = {"buckets": tuple(
+                jnp.zeros(tuple(b.shape), jnp.float32)
+                for b in a["ef"]["buckets"]
+            )}
+            if saved_container is None:
+                logger.warning(
+                    "restore_checkpoint: checkpoint carries no "
+                    "error-feedback residual (saved before the stateful "
+                    "codec was enabled): starting from ZERO residuals — "
+                    "convergence-neutral, the error feedback re-warms "
+                    "within a few steps"
+                )
+                merged = dict(a2) if isinstance(a2, dict) else {}
+                merged["ef"] = zeros
+                return state._replace(algo_state=merged)
+            restored_ef = a2["ef"]
+            if int(saved_ef["world"]) != live_world:
+                logger.warning(
+                    "restore_checkpoint: error-feedback residual was saved "
+                    "at world_size=%d, trainer runs %d (elastic resize): "
+                    "zero-resetting the residual — convergence-neutral, "
+                    "the error feedback re-warms within a few steps",
+                    int(saved_ef["world"]), live_world,
+                )
+                return state._replace(
+                    algo_state={**a2, "ef": zeros}
+                )
+            if ef_plan.signature() != live_plan.signature():
+                logger.info(
+                    "restore_checkpoint: relaying out the error-feedback "
+                    "residual %d -> %d buckets",
+                    len(ef_plan.buckets), len(live_plan.buckets),
+                )
+                migrated = self.algorithm.relayout_algo_state(
+                    ef_plan, live_plan, {"ef": restored_ef}
+                )
+                return state._replace(
+                    algo_state={**a2, "ef": migrated["ef"]}
+                )
+            return state
+
+        return state_like._replace(algo_state=adapted_algo), fixup
+
+    def _restore_checkpoint_body(self, manager, state_like: TrainState,
+                                 step: int):
         expected = self.checkpoint_layout_metadata()
         saved = manager.read_layout(step)
         # the manager owns legacy-alias normalization ("zero_flat"->"flat")

@@ -73,9 +73,12 @@ _declare("BAGUA_COMPRESS_INTRA", "str", "auto",
          "Per-link codec policy for the slice-local ICI tier (and the flat "
          "single-axis ring): `auto` (default) keeps ICI full-precision — "
          "slice-local bytes are cheap; `off` forces full precision; a "
-         "codec name (minmax_uint8|int8|fp8_e4m3|fp8_e5m2) makes the flat/"
-         "intra ring hops carry that codec's payload — an explicit opt-in "
-         "to lossy gradient communication.  See docs/compression.md.")
+         "codec name (minmax_uint8|int8|fp8_e4m3|fp8_e5m2|onebit_ef|topk) "
+         "makes the flat/intra ring hops carry that codec's payload — an "
+         "explicit opt-in to lossy gradient communication.  The stateful "
+         "codecs (onebit_ef, topk) additionally engage the per-bucket "
+         "error-feedback residual on the families that support it.  See "
+         "docs/compression.md.")
 _declare("BAGUA_COMPRESS_INTER", "str", "auto",
          "Per-link codec policy for the cross-slice DCN tier of the "
          "hierarchical two-level collectives: `auto` (default) defers to "
@@ -83,9 +86,28 @@ _declare("BAGUA_COMPRESS_INTER", "str", "auto",
          "natively (quantized ring hops, fp32 accumulation), exact "
          "families stay full precision; `off` forces full precision even "
          "for the compression families; a codec name "
-         "(minmax_uint8|int8|fp8_e4m3|fp8_e5m2) compresses the DCN hops "
-         "for EVERY family.  The autopilot's compress_dcn trend hint "
-         "actuates this knob through the autotune recommendation path.")
+         "(minmax_uint8|int8|fp8_e4m3|fp8_e5m2|onebit_ef|topk) compresses "
+         "the DCN hops for EVERY family.  The autopilot's compress_dcn "
+         "trend hint actuates this knob through the autotune "
+         "recommendation path, escalating along the codec ladder "
+         "uint8 -> fp8 -> onebit_ef -> topk on sustained DCN dominance.")
+_declare("BAGUA_TOPK_RATIO", "float", "0.01",
+         "Compression-ratio knob of the `topk` ring codec: fraction of "
+         "each chunk's elements kept on the wire (indices + f32 values; "
+         "0.01 keeps the top 1% by magnitude, ~50x fewer DCN bytes than "
+         "f32).  Resolved when the codec is looked up (trainer "
+         "construction / step trace) and keyed into the step cache, so a "
+         "changed value retraces the compiled payload shapes.  See "
+         "docs/compression.md.")
+_declare("BAGUA_EF_RESIDUAL", "enum", "on",
+         "Error-feedback residual for the stateful ring codecs "
+         "(onebit_ef/topk): `on` (default) accumulates the per-bucket "
+         "quantization error and folds it into the next step's gradient "
+         "— the convergence contract of 1-bit compression; `off` lets the "
+         "codec ride STATELESSLY (biased sign-SGD — diverges on real "
+         "tasks; the BENCH_COMPRESS honesty control).  Set before trainer "
+         "construction: flipping it mid-run changes the train-state "
+         "structure.", choices=("on", "off"))
 _declare("BAGUA_FLAT_RESIDENT", "enum", "auto",
          "Flat-resident training state: keep params/grads/optimizer state "
          "as bucket-flat buffers across steps (`on`), keep the leaf pytree "
@@ -432,6 +454,12 @@ _declare("BAGUA_SCALE_SEED", "int", "0",
          "jitter hash and the drill's per-rank gradient vectors both "
          "derive from it, so two runs at one seed inject identical "
          "network time.")
+_declare("BAGUA_SCALE_DCN_CODEC", "str", "minmax_uint8",
+         "Wire codec for the pod simulator's cross-slice DCN ring "
+         "(f32|minmax_uint8|onebit_ef|topk): scale_drill.py exercises the "
+         "selected codec's numpy mirror cross-process and verifies the "
+         "hierarchical allreduce within its quantization tolerance.  See "
+         "bagua_tpu.podsim.collectives and docs/podsim.md.")
 
 
 # ---- typed accessors -----------------------------------------------------
@@ -627,6 +655,20 @@ def get_compress_inter() -> str:
     """Per-link codec policy for the cross-slice DCN tier (``auto``
     default — defer to the algorithm family's wire codec)."""
     return env_str("BAGUA_COMPRESS_INTER")
+
+
+def get_topk_ratio() -> float:
+    """Fraction of each chunk's elements the ``topk`` ring codec keeps on
+    the wire (default 0.01).  Read each time the codec is resolved
+    (``get_codec`` re-constructs env-tuned codecs) and keyed into the
+    step cache — the compiled payload shapes follow the knob."""
+    return env_float("BAGUA_TOPK_RATIO")
+
+
+def is_ef_residual_disabled() -> bool:
+    """True when ``BAGUA_EF_RESIDUAL=off`` — the stateful codecs ride
+    statelessly (biased; the BENCH_COMPRESS honesty control)."""
+    return env_enum("BAGUA_EF_RESIDUAL") == "off"
 
 
 def get_flat_resident_mode() -> str:
@@ -966,6 +1008,12 @@ def get_scale_shape() -> str:
 
 def get_scale_seed() -> int:
     return env_int("BAGUA_SCALE_SEED")
+
+
+def get_scale_dcn_codec() -> str:
+    """Wire codec for the pod simulator's cross-slice DCN ring (numpy
+    mirror; default ``minmax_uint8``)."""
+    return env_str("BAGUA_SCALE_DCN_CODEC")
 
 
 def get_elastic_store_addr() -> Optional[str]:

@@ -35,8 +35,28 @@ __all__ = [
 _HDR = struct.Struct("<IB")
 _CODEC_F32 = 0
 _CODEC_MINMAX_U8 = 1
-_CODEC_IDS = {"f32": _CODEC_F32, "minmax_uint8": _CODEC_MINMAX_U8}
+_CODEC_ONEBIT = 2
+_CODEC_TOPK = 3
+_CODEC_IDS = {"f32": _CODEC_F32, "minmax_uint8": _CODEC_MINMAX_U8,
+              "onebit_ef": _CODEC_ONEBIT, "topk": _CODEC_TOPK}
 _SIDECAR = struct.Struct("<ff")  # lo, hi
+#: onebit sidecar: u32 element count (packbits pads to a byte multiple,
+#: so the frame must carry the true length), f32 mean-abs scale
+_ONEBIT_SIDECAR = struct.Struct("<If")
+#: topk header: u32 element count, u32 selected count
+_TOPK_HDR = struct.Struct("<II")
+
+
+def _topk_ratio() -> float:
+    """The production knob (``BAGUA_TOPK_RATIO``) read directly from the
+    process environment — the worker bootstrap deliberately avoids
+    importing :mod:`bagua_tpu.env` (it pulls the jax runtime)."""
+    import os
+
+    # bagua: lint-ignore[raw-env-read] -- the jax-free worker shim cannot
+    # import bagua_tpu.env (the package __init__ pulls the jax runtime);
+    # default mirrors the ENV_REGISTRY declaration
+    return float(os.environ.get("BAGUA_TOPK_RATIO", "0.01"))
 
 
 def encode_chunk(idx: int, x: "np.ndarray", codec: str) -> bytes:
@@ -47,6 +67,24 @@ def encode_chunk(idx: int, x: "np.ndarray", codec: str) -> bytes:
     cid = _CODEC_IDS[codec]
     if cid == _CODEC_F32:
         return _HDR.pack(int(idx), cid) + x.astype("<f4").tobytes()
+    if cid == _CODEC_ONEBIT:
+        # sign wire model: 1 bit/element + a mean-abs scale — the 1-bit
+        # ring's ~32x byte reduction (a non-finite input poisons the
+        # scale, so the decoded chunk is all-NaN: the grad-guard
+        # propagation contract holds on the wire mirror too)
+        scale = float(np.mean(np.abs(x))) if x.size else 0.0
+        bits = np.packbits(x >= 0.0)
+        return (_HDR.pack(int(idx), cid)
+                + _ONEBIT_SIDECAR.pack(x.size, scale) + bits.tobytes())
+    if cid == _CODEC_TOPK:
+        n = int(x.size)
+        kk = max(1, min(n, int(np.ceil(n * _topk_ratio())))) if n else 0
+        mag = np.where(np.isfinite(x), np.abs(x), np.inf)
+        sel = np.argpartition(mag, n - kk)[n - kk:] if n else \
+            np.zeros(0, np.int64)
+        return (_HDR.pack(int(idx), cid) + _TOPK_HDR.pack(n, kk)
+                + sel.astype("<i4").tobytes()
+                + x[sel].astype("<f4").tobytes())
     lo = float(x.min()) if x.size else 0.0
     hi = float(x.max()) if x.size else 0.0
     scale = (hi - lo) / 255.0 if hi > lo else 1.0
@@ -59,6 +97,19 @@ def decode_chunk(frame: bytes) -> Tuple[int, "np.ndarray"]:
     body = frame[_HDR.size:]
     if cid == _CODEC_F32:
         return idx, np.frombuffer(body, dtype="<f4").astype(np.float32)
+    if cid == _CODEC_ONEBIT:
+        n, scale = _ONEBIT_SIDECAR.unpack_from(body)
+        bits = np.frombuffer(body[_ONEBIT_SIDECAR.size:], dtype=np.uint8)
+        signs = np.unpackbits(bits)[:n].astype(np.float32) * 2.0 - 1.0
+        return idx, signs * np.float32(scale)
+    if cid == _CODEC_TOPK:
+        n, kk = _TOPK_HDR.unpack_from(body)
+        off = _TOPK_HDR.size
+        sel = np.frombuffer(body[off:off + 4 * kk], dtype="<i4")
+        vals = np.frombuffer(body[off + 4 * kk:off + 8 * kk], dtype="<f4")
+        out = np.zeros(n, dtype=np.float32)
+        out[sel] = vals
+        return idx, out
     lo, hi = _SIDECAR.unpack_from(body)
     q = np.frombuffer(body[_SIDECAR.size:], dtype=np.uint8)
     scale = (hi - lo) / 255.0 if hi > lo else 1.0
@@ -67,17 +118,32 @@ def decode_chunk(frame: bytes) -> Tuple[int, "np.ndarray"]:
 
 def wire_bytes(nelems: int, codec: str) -> int:
     """Frame size for ``nelems`` f32 elements under ``codec`` — the
-    shaper charges these bytes, so the DCN tier's 4x reduction shows up
-    in injected serialization time exactly like the fused path."""
-    if _CODEC_IDS[codec] == _CODEC_F32:
-        return _HDR.size + 4 * int(nelems)
-    return _HDR.size + _SIDECAR.size + int(nelems)
+    shaper charges these bytes, so the DCN tier's byte reduction (4x u8,
+    ~32x onebit, ~50x topk at the default 1% ratio) shows up in injected
+    serialization time exactly like the fused path."""
+    cid = _CODEC_IDS[codec]
+    n = int(nelems)
+    if cid == _CODEC_F32:
+        return _HDR.size + 4 * n
+    if cid == _CODEC_ONEBIT:
+        return _HDR.size + _ONEBIT_SIDECAR.size + -(-n // 8)
+    if cid == _CODEC_TOPK:
+        kk = max(1, min(n, int(np.ceil(n * _topk_ratio())))) if n else 0
+        return _HDR.size + _TOPK_HDR.size + 8 * kk
+    return _HDR.size + _SIDECAR.size + n
 
 
-def quantization_atol(x_span: float, reduce_hops: int) -> float:
+def quantization_atol(x_span: float, reduce_hops: int,
+                      codec: str = "minmax_uint8") -> float:
     """Worst-case absolute error of a mean computed through ``reduce_hops``
-    u8-quantized additions of values spanning ``x_span``: half a
-    quantization step per encode, accumulated."""
+    codec-quantized additions of values spanning ``x_span``.  u8: half a
+    quantization step per encode, accumulated.  onebit/topk are LOSSY by
+    construction (the production path pairs them with an error-feedback
+    residual the stateless mirror does not carry), so their bound is
+    span-scale: it proves transport integrity — frames reassemble, the
+    reduction stays finite and magnitude-bounded — not fidelity."""
+    if _CODEC_IDS.get(codec) in (_CODEC_ONEBIT, _CODEC_TOPK):
+        return x_span * float(max(1, reduce_hops)) + 1e-5
     return (x_span / 255.0) * 0.5 * max(1, reduce_hops) + 1e-5
 
 

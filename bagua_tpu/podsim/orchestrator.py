@@ -57,6 +57,7 @@ class PodSim:
                  min_nnodes: int = 1,
                  steps: int = 0, vec_elems: int = 16384,
                  shape: str = "pod", slice_size: int = 8, seed: int = 0,
+                 dcn_codec: str = "minmax_uint8",
                  hb_interval_s: float = 0.5, lease_ttl_s: float = 4.0,
                  join_window_s: float = 30.0, timeout_s: float = 120.0,
                  policy: Optional[PolicyConfig] = None,
@@ -71,6 +72,7 @@ class PodSim:
         self.shape = str(shape)
         self.slice_size = int(slice_size)
         self.seed = int(seed)
+        self.dcn_codec = str(dcn_codec)
         self.hb_interval_s = float(hb_interval_s)
         self.lease_ttl_s = float(lease_ttl_s)
         self.timeout_s = float(timeout_s)
@@ -127,7 +129,7 @@ class PodSim:
             "--node-id", str(node_id), "--max-nnodes", str(self.world),
             "--steps", str(self.steps), "--vec-elems", str(self.vec_elems),
             "--shape", self.shape, "--slice-size", str(self.slice_size),
-            "--seed", str(self.seed),
+            "--seed", str(self.seed), "--dcn-codec", self.dcn_codec,
             "--hb-interval", str(self.hb_interval_s),
             "--timeout", str(self.timeout_s),
         ]

@@ -81,7 +81,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap.add_argument("--slice-size", type=int, default=8)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--dcn-codec", default="minmax_uint8",
-                    choices=("minmax_uint8", "f32"))
+                    choices=("minmax_uint8", "f32", "onebit_ef", "topk"))
     ap.add_argument("--hb-interval", type=float, default=0.5)
     ap.add_argument("--timeout", type=float, default=120.0)
     return ap.parse_args(argv)
@@ -167,7 +167,8 @@ def _data_plane(args, store, spec: WorldSpec, state: dict) -> dict:
         for r in range(world)
     ]
     expected = np.mean(vecs, axis=0)
-    atol = (C.quantization_atol(2.0 * intra, 2 * max(1, inter - 1))
+    atol = (C.quantization_atol(2.0 * intra, 2 * max(1, inter - 1),
+                                args.dcn_codec)
             if args.dcn_codec != "f32" and inter > 1 else 1e-4)
 
     max_err, t0 = 0.0, time.monotonic()

@@ -50,6 +50,20 @@ import optax
 SCHEMA = "bagua-bench-compress-v1"
 INTER = 2
 CODECS = ("minmax_uint8", "int8", "fp8_e4m3", "fp8_e5m2")
+#: the stateful (error-feedback) codecs ride the same forced-DCN sweep but
+#: carry a steeper gate: 1-bit payloads + f32 scale sidecar must clear
+#: 12x over the full-precision hops (32x asymptotic), and top-k at the
+#: default 1% ratio clears it with room
+EF_CODECS = ("onebit_ef", "topk")
+DCN_GATES = {codec: 3.0 for codec in CODECS}
+DCN_GATES.update({"onebit_ef": 12.0, "topk": 12.0})
+
+#: EF convergence protocol: bench.golden_task() for this many steps; the
+#: compensated run must land within TOLERANCE of the uncompressed final
+#: loss, the residual-disabled control must NOT (the gap is the bias the
+#: error feedback exists to cancel)
+EF_CONV_STEPS = 60
+EF_CONV_TOLERANCE = 0.2
 
 #: measurement sizing per platform: (timed steps, per-chip batch rows)
 _TIMED = {"tpu": (20, 128), "cpu": (30, 32)}
@@ -184,6 +198,39 @@ def tier_wire_bytes(config: str) -> dict:
             "collectives": n}
 
 
+def golden_final_loss(codec, ef: bool, steps: int = EF_CONV_STEPS) -> float:
+    """Final ``bench.golden_task()`` loss after ``steps`` fixed-batch steps
+    on the two-level mesh, with ``compress_inter=codec`` and the
+    error-feedback residual on/off (``BAGUA_EF_RESIDUAL``).  Deterministic
+    per platform — fixed seeds, fixed reduction order."""
+    import bench
+    from bagua_tpu.algorithms import GradientAllReduceAlgorithm
+    from bagua_tpu.core.backend import BaguaTrainer
+
+    prev = os.environ.pop("BAGUA_EF_RESIDUAL", None)
+    if not ef:
+        os.environ["BAGUA_EF_RESIDUAL"] = "off"
+    try:
+        loss_fn, params, batch = bench.golden_task()
+        kw = {} if codec is None else {"compress_inter": codec}
+        trainer = BaguaTrainer(
+            loss_fn, optax.sgd(0.1),
+            GradientAllReduceAlgorithm(hierarchical=True), mesh=_mesh(),
+            autotune=False, overlap="off", bucket_bytes=65536, **kw,
+        )
+        state = trainer.init(params)
+        data = trainer.shard_batch(batch)
+        loss = None
+        for _ in range(steps):
+            state, loss = trainer.train_step(state, data)
+        return float(loss)
+    finally:
+        if prev is None:
+            os.environ.pop("BAGUA_EF_RESIDUAL", None)
+        else:
+            os.environ["BAGUA_EF_RESIDUAL"] = prev
+
+
 def measure(config: str) -> dict:
     """One throughput record (the suite's min-of-2-windows methodology)."""
     import bench
@@ -230,11 +277,11 @@ def run_suite(out_path: str = "BENCH_COMPRESS.json", trials: int = 3) -> list:
 
     # -- the acceptance signal: compressed vs full-precision DCN hops ----
     fp = tier_wire_bytes("allreduce_fp")
-    for codec in CODECS:
+    for codec in CODECS + EF_CODECS:
         comp = tier_wire_bytes(f"allreduce_{codec}")
         reduction = (fp["dcn_bytes_per_step"] - loss_scalar) / (
             comp["dcn_bytes_per_step"] - loss_scalar)
-        emit({
+        rec = {
             "metric": f"compress_dcn_reduction_{codec}",
             "value": round(reduction, 3),
             "unit": "full-precision/compressed DCN bytes per step",
@@ -242,7 +289,7 @@ def run_suite(out_path: str = "BENCH_COMPRESS.json", trials: int = 3) -> list:
             "intra_size": intra,
             "full_precision": fp,
             "compressed": comp,
-            "gate": 3.0,
+            "gate": DCN_GATES[codec],
             "note": (
                 "jaxpr collective operand bytes, exact on any platform; "
                 "gradient_allreduce two-level with compress_inter forced "
@@ -250,7 +297,27 @@ def run_suite(out_path: str = "BENCH_COMPRESS.json", trials: int = 3) -> list:
                 "codec's f32 sidecar per hop (scalar loss reduction "
                 "excluded from the ratio)"
             ),
-        })
+        }
+        if codec == "onebit_ef":
+            rec["note"] = (
+                "jaxpr collective operand bytes, exact on any platform; "
+                "4-byte f32 shards become bit-packed sign payloads "
+                "(1 bit/elem, 128-byte lanes) + the per-bucket f32 "
+                "mean-abs scale per hop — 32x asymptotic, gated at 12x "
+                "to absorb the lane padding and sidecar on small buckets"
+            )
+        elif codec == "topk":
+            from bagua_tpu import env as _env
+
+            rec["topk_ratio"] = _env.get_topk_ratio()
+            rec["note"] = (
+                "jaxpr collective operand bytes, exact on any platform; "
+                "the first VARIABLE-payload codec — each hop carries "
+                "int32 indices + f32 values for the top k=ceil(ratio*n) "
+                "magnitudes (BAGUA_TOPK_RATIO, default 1%): 8*k bytes "
+                "per hop vs 4*n full precision"
+            )
+        emit(rec)
 
     # -- bytegrad: the fused form vs full-precision DCN (the acceptance
     #    comparison) and vs the PR-11 discrete-stage form (honesty) ------
@@ -294,6 +361,38 @@ def run_suite(out_path: str = "BENCH_COMPRESS.json", trials: int = 3) -> list:
             "policy every two-level family now rides"
         ),
     })
+
+    # -- EF convergence: the residual is WHY the lossy codecs are usable.
+    #    The compensated run must match the uncompressed trajectory within
+    #    the committed tolerance; the residual-disabled control must NOT —
+    #    otherwise the task is too easy to certify the codec ------------
+    fp_final = golden_final_loss(None, ef=True)
+    for codec in EF_CODECS:
+        on_final = golden_final_loss(codec, ef=True)
+        off_final = golden_final_loss(codec, ef=False)
+        on_gap = abs(on_final - fp_final)
+        off_gap = abs(off_final - fp_final)
+        emit({
+            "metric": f"compress_ef_convergence_{codec}",
+            "value": round(on_gap, 4),
+            "unit": "|final loss - uncompressed| on bench.golden_task()",
+            "codec": codec,
+            "steps": EF_CONV_STEPS,
+            "tolerance": EF_CONV_TOLERANCE,
+            "uncompressed_final_loss": round(fp_final, 6),
+            "ef_on_final_loss": round(on_final, 6),
+            "ef_off_final_loss": round(off_final, 6),
+            "ef_off_gap": round(off_gap, 4),
+            "note": (
+                "gradient_allreduce two-level, compress_inter forced, "
+                "%d fixed-batch sgd(0.1) steps; EF-on must land within "
+                "the tolerance of the uncompressed final loss AND the "
+                "EF-off control (BAGUA_EF_RESIDUAL=off) must not — the "
+                "separation is the quantization bias the residual "
+                "cancels, and proves the task is hard enough to "
+                "certify the codec" % EF_CONV_STEPS
+            ),
+        })
 
     # -- interleaved throughput A/B (honest: cpu-sim pays the codec's
     #    compute and saves no wire time) ---------------------------------

@@ -129,6 +129,16 @@ echo "=== scale smoke (4-process loopback pod drill) ==="
 # regenerate it with `python scripts/scale_drill.py`.
 timeout -k 10 120 python scripts/scale_drill.py --smoke > /dev/null
 
+echo "=== compressed-ring smoke (1-bit EF codec over the loopback pod) ==="
+# The stateful ISSUE-17 wire format end to end over real sockets: the same
+# 4-process drill with the DCN stage forced onto bit-packed sign payloads
+# + mean-abs sidecars (the numpy mirror of the jax codec) — the workers'
+# transport-integrity bounds must hold and the verdict records the codec.
+# The jaxpr-exact >=12x DCN byte pins and the EF convergence separation
+# live in BENCH_COMPRESS.json (schema-gated in tests/test_bench_sanity.py).
+timeout -k 10 120 env BAGUA_SCALE_DCN_CODEC=onebit_ef \
+  python scripts/scale_drill.py --smoke > /dev/null
+
 echo "=== chaos fast subset (fault injection -> detection -> recovery) ==="
 # The deterministic slice of scripts/chaos_drill.py: every injection point
 # fires, every detector sees it, every recovery completes.  The committed

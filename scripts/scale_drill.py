@@ -93,7 +93,8 @@ def _policy():
 
 
 def full_scenario(world, workdir, shape, seed, steps=1, vec_elems=8192,
-                  slice_size=8, timeout_s=180.0):
+                  slice_size=8, timeout_s=180.0,
+                  dcn_codec="minmax_uint8"):
     """The end-to-end proof at one world size: every phase of the
     coordinator's life driven against real processes, every phase
     asserted.  Returns (checks, metrics)."""
@@ -101,7 +102,8 @@ def full_scenario(world, workdir, shape, seed, steps=1, vec_elems=8192,
     t0 = time.monotonic()
     with PodSim(world, workdir, min_nnodes=2, steps=steps,
                 vec_elems=vec_elems, shape=shape, slice_size=slice_size,
-                seed=seed, lease_ttl_s=4.0, join_window_s=60.0,
+                seed=seed, dcn_codec=dcn_codec, lease_ttl_s=4.0,
+                join_window_s=60.0,
                 timeout_s=timeout_s, policy=_policy()) as sim:
         sim.spawn_all()
         spec = sim.rendezvous(1)
@@ -382,8 +384,10 @@ def run_smoke(args):
     workdir = tempfile.mkdtemp(prefix="podsim_smoke_")
     checks, metrics = full_scenario(
         4, workdir, shape=args.shape, seed=args.seed, steps=2,
-        vec_elems=4096, slice_size=2, timeout_s=90.0)
-    verdict = {"drill": "scale-smoke", "world": 4, "checks": checks,
+        vec_elems=4096, slice_size=2, timeout_s=90.0,
+        dcn_codec=args.dcn_codec)
+    verdict = {"drill": "scale-smoke", "world": 4,
+               "dcn_codec": args.dcn_codec, "checks": checks,
                "metrics": metrics, "log_dir": workdir,
                "ok": all(checks.values())}
     print(json.dumps(verdict, indent=1, sort_keys=True))
@@ -402,7 +406,8 @@ def run_full(args):
         if i == 0:
             checks, live = full_scenario(
                 world, workdir, shape=args.shape, seed=args.seed,
-                steps=args.steps, slice_size=args.slice_size)
+                steps=args.steps, slice_size=args.slice_size,
+                dcn_codec=args.dcn_codec)
         else:
             checks, live = light_scenario(
                 world, workdir, shape=args.shape, seed=args.seed)
@@ -459,6 +464,7 @@ def run_full(args):
         "host_cores": os.cpu_count(),
         "shape": args.shape,
         "seed": args.seed,
+        "dcn_codec": args.dcn_codec,
         "worlds": worlds,
         "bottlenecks": bottlenecks,
         "checks": all_checks,
@@ -496,10 +502,16 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=1,
                     help="collective steps per epoch in the full scenario")
     ap.add_argument("--slice-size", type=int, default=8)
+    ap.add_argument("--dcn-codec", default=None,
+                    choices=("minmax_uint8", "f32", "onebit_ef", "topk"),
+                    help="wire codec of the shaped DCN tier (default: "
+                         "BAGUA_SCALE_DCN_CODEC)")
     ap.add_argument("--out", default=os.path.join(_REPO, "BENCH_SCALE.json"))
     args = ap.parse_args(argv)
     args.shape = _env.get_scale_shape() if args.shape is None else args.shape
     args.seed = _env.get_scale_seed() if args.seed is None else args.seed
+    if args.dcn_codec is None:
+        args.dcn_codec = _env.get_scale_dcn_codec()
     if args.ranks is None:
         args.ranks = _env.get_scale_ranks()
     else:

@@ -194,9 +194,28 @@ def test_bench_compress_artifact_schema():
         assert rec["compressed"]["dcn_bytes_per_step"] > 0
         assert rec["full_precision"]["dcn_bytes_per_step"] > \
             rec["compressed"]["dcn_bytes_per_step"]
+    # the error-feedback codecs carry the steeper ISSUE-17 gate: bit-packed
+    # signs (+f32 scale sidecar) and 1% top-k must push DCN >= 12x
+    for codec in ("onebit_ef", "topk"):
+        rec = by_metric[f"compress_dcn_reduction_{codec}"]
+        assert rec["value"] >= rec["gate"] == 12.0, rec
+        assert rec["compressed"]["dcn_bytes_per_step"] > 0
+    assert by_metric["compress_dcn_reduction_topk"]["topk_ratio"] == 0.01
     bg = by_metric["compress_dcn_reduction_bytegrad"]
     assert bg["value"] >= bg["gate"] == 3.0, bg
     assert bg["codec"] == "minmax_uint8"
+
+    # EF convergence separation: the compensated run matches the
+    # uncompressed golden-task trajectory within the committed tolerance;
+    # the residual-disabled control does NOT (its gap is the quantization
+    # bias the residual exists to cancel — if the control also passed, the
+    # task would be too easy to certify the codec)
+    for codec in ("onebit_ef", "topk"):
+        conv = by_metric[f"compress_ef_convergence_{codec}"]
+        assert conv["value"] <= conv["tolerance"], conv
+        assert conv["ef_off_gap"] > conv["tolerance"], conv
+        assert conv["ef_off_gap"] > conv["value"], conv
+        assert conv["steps"] >= 30
 
     # the honesty record: the discrete scatter-gather stage already moved
     # u8 across DCN — its ratio over the fused form is structural, small,

@@ -57,6 +57,13 @@ NCLASS = 10
 MODEL = MLP(features=(16, NCLASS))
 
 ALL_CODECS = sorted(CODECS)
+#: the stateless grid codecs: payload element per input element, no
+#: error-feedback residual required — the psum-match bounds below only
+#: hold for these (onebit/topk are lossy by construction and converge
+#: through the EF residual, pinned in test_ef_residual.py)
+UNIFORM_CODECS = [n for n in ALL_CODECS
+                  if not get_codec(n).error_feedback]
+EF_CODECS = [n for n in ALL_CODECS if get_codec(n).error_feedback]
 
 
 def _loss_fn(params, batch):
@@ -75,25 +82,79 @@ def test_codec_roundtrip_error_bounded(name):
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(4, 512)).astype(np.float32))
     parts = codec.encode(x)
-    assert parts[-1].dtype.itemsize == 1  # 1-byte payloads: the 4x win
-    y = codec.decode(parts)
+    if name != "topk":  # topk's value part travels exact f32
+        assert parts[-1].dtype.itemsize == 1  # 1-byte payloads: the 4x win
+    y = codec.decode(parts, x.shape[1])
     assert y.dtype == jnp.float32  # the accumulation-dtype contract
+    assert y.shape == x.shape
     err = np.abs(np.asarray(y) - np.asarray(x)).max(axis=1)
     span = np.asarray(x).max(axis=1) - np.asarray(x).min(axis=1)
     if name == "minmax_uint8":
         bound = span / 255.0 + 1e-6
     elif name == "int8":
         bound = np.abs(np.asarray(x)).max(axis=1) / 127.0 + 1e-6
+    elif name in ("onebit_ef", "topk"):
+        # lossy-by-construction: per-element error bounded by the chunk's
+        # largest magnitude (+ the sign scale for onebit) — the residual
+        # re-injects the rest (test_ef_residual.py)
+        bound = (np.abs(np.asarray(x)).max(axis=1)
+                 + np.abs(np.asarray(x)).mean(axis=1))
     else:  # fp8: 2^-mantissa_bits relative + the scale quantization
         rel = 0.0625 if name == "fp8_e4m3" else 0.25
         bound = np.abs(np.asarray(x)).max(axis=1) * rel
     assert (err <= bound).all(), (name, err, bound)
 
 
+def test_onebit_decode_matches_sign_times_meanabs():
+    """The 1-bit wire is exactly ``mean|x| * sign(x)`` per chunk — the
+    L1-optimal magnitude for a sign quantizer — through the bit-packed
+    payload round trip."""
+    codec = get_codec("onebit_ef")
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(3, 300)).astype(np.float32)  # non-multiple of 1024
+    y = np.asarray(codec.decode(codec.encode(jnp.asarray(x)), 300))
+    scale = np.abs(x).mean(axis=1, keepdims=True)
+    signs = np.where(x >= 0, 1.0, -1.0).astype(np.float32)
+    np.testing.assert_allclose(y, signs * scale, rtol=1e-6)
+    assert codec.wire_bytes(1024) == 128 + 4  # the ~32x win
+
+
+def test_topk_selects_largest_and_requires_m():
+    codec = get_codec("topk")
+    rng = np.random.default_rng(6)
+    x = rng.uniform(-1, 1, size=(2, 400)).astype(np.float32)
+    x[0, 11] = 9.0
+    parts = codec.encode(jnp.asarray(x))
+    with pytest.raises(ValueError, match="variable-payload"):
+        codec.decode(parts)
+    y = np.asarray(codec.decode(parts, 400))
+    kk = codec.k_for(400)
+    assert (np.count_nonzero(y, axis=1) == kk).all()
+    assert y[0, 11] == 9.0  # largest magnitude survives exactly
+    sel = np.nonzero(y[1])[0]
+    np.testing.assert_array_equal(y[1, sel], x[1, sel])
+
+
+def test_topk_ratio_env_knob_resolves_per_lookup(monkeypatch):
+    """BAGUA_TOPK_RATIO must take effect at codec RESOLUTION time (trainer
+    construction / step trace), like every other BAGUA_* knob — not be
+    frozen by the import-time registry singleton."""
+    assert get_codec("topk").ratio == pytest.approx(0.01)
+    monkeypatch.setenv("BAGUA_TOPK_RATIO", "0.25")
+    codec = get_codec("topk")
+    assert codec.ratio == pytest.approx(0.25)
+    assert codec.k_for(400) == 100
+    assert codec.wire_bytes(400) == 8 * 100
+    monkeypatch.delenv("BAGUA_TOPK_RATIO")
+    assert get_codec("topk").k_for(400) == 4
+    # the stateless singletons keep resolving to one shared instance
+    assert get_codec("minmax_uint8") is get_codec("minmax_uint8")
+
+
 @pytest.mark.parametrize("name", ALL_CODECS)
 def test_codec_zeros_roundtrip_exact(name):
     codec = get_codec(name)
-    y = codec.decode(codec.encode(jnp.zeros((2, 128), jnp.float32)))
+    y = codec.decode(codec.encode(jnp.zeros((2, 128), jnp.float32)), 128)
     assert (np.asarray(y) == 0).all()
 
 
@@ -108,7 +169,7 @@ def test_codec_nonfinite_propagates(name, poison):
     rng = np.random.default_rng(1)
     x = rng.normal(size=(3, 64)).astype(np.float32)
     x[1, 7] = poison
-    y = np.asarray(codec.decode(codec.encode(jnp.asarray(x))))
+    y = np.asarray(codec.decode(codec.encode(jnp.asarray(x)), 64))
     assert not np.isfinite(y[1]).all(), (name, poison)
     assert np.isfinite(y[0]).all() and np.isfinite(y[2]).all()
 
@@ -131,7 +192,7 @@ def test_fp8_denormal_range_roundtrip(name):
 
 def test_codec_policy_validation():
     for v in ("off", "auto", "minmax_uint8", "int8", "fp8_e4m3",
-              "fp8_e5m2"):
+              "fp8_e5m2", "onebit_ef", "topk"):
         assert validate_codec_policy(v, "k") == v
     assert validate_codec_policy("", "k") == "auto"
     assert validate_codec_policy("AUTO", "k") == "auto"
@@ -163,7 +224,7 @@ def _run_flat(fn, xs):
     return np.asarray(out)
 
 
-@pytest.mark.parametrize("name", ALL_CODECS)
+@pytest.mark.parametrize("name", UNIFORM_CODECS)
 @pytest.mark.parametrize("num_chunks", [1, 4])
 def test_ring_allreduce_codec_matches_psum_bounded(name, num_chunks):
     rng = np.random.default_rng(3)
@@ -184,6 +245,27 @@ def test_ring_allreduce_codec_matches_psum_bounded(name, num_chunks):
     rel = {"minmax_uint8": 2 / 255.0, "int8": 2 / 127.0,
            "fp8_e4m3": 0.0625, "fp8_e5m2": 0.25}[name]
     assert np.abs(out[0] - ref).max() <= N * amax * rel
+
+
+@pytest.mark.parametrize("name", EF_CODECS)
+@pytest.mark.parametrize("num_chunks", [1, 4])
+def test_ring_allreduce_lossy_codec_ranks_identical(name, num_chunks):
+    """The sign/sparse codecs through the REAL chunked ring: no psum-match
+    bound (stateless they are lossy by construction — convergence rides
+    the EF residual), but the wire contract still holds: every rank
+    decodes the same forwarded payloads bit-identically and the result
+    stays finite."""
+    rng = np.random.default_rng(8)
+    xs = rng.normal(size=(N, 64)).astype(np.float32)
+    out = _run_flat(
+        lambda c, x: c.ring_allreduce(x, ReduceOp.AVG,
+                                      num_chunks=num_chunks, codec=name),
+        xs,
+    )
+    for r in range(1, N):
+        np.testing.assert_array_equal(out[0], out[r])
+    assert np.isfinite(out).all()
+    assert np.abs(out).max() > 0.0
 
 
 @pytest.mark.parametrize("name", ["minmax_uint8", "int8"])

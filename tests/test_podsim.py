@@ -169,6 +169,44 @@ def test_codec_minmax_uint8_error_bound_and_wire_bytes():
     np.testing.assert_allclose(y, 2.5, atol=1e-6)
 
 
+def test_codec_onebit_sign_scale_and_wire_bytes():
+    rng = np.random.default_rng(3)
+    x = rng.uniform(-2, 2, 1000).astype(np.float32)
+    idx, y = C.decode_chunk(C.encode_chunk(7, x, "onebit_ef"))
+    assert idx == 7 and y.shape == x.shape
+    # decoded chunk is sign(x) * mean|x|: signs exact, one magnitude
+    scale = float(np.mean(np.abs(x)))
+    np.testing.assert_allclose(y, np.sign(x) * scale, atol=1e-6)
+    # ~32x: 1 bit/element + header + (nelems, scale) sidecar
+    assert C.wire_bytes(4096, "onebit_ef") == 5 + 8 + 512
+    assert len(C.encode_chunk(0, x, "onebit_ef")) == \
+        C.wire_bytes(x.size, "onebit_ef")
+    # non-finite input poisons the scale -> whole chunk NaN (the
+    # grad-guard propagation contract on the wire mirror)
+    x[13] = np.nan
+    _, y = C.decode_chunk(C.encode_chunk(0, x, "onebit_ef"))
+    assert not np.isfinite(y).any()
+
+
+def test_codec_topk_sparse_roundtrip_and_wire_bytes():
+    rng = np.random.default_rng(4)
+    x = rng.uniform(-1, 1, 1000).astype(np.float32)
+    x[37] = 50.0  # unambiguous top element
+    idx, y = C.decode_chunk(C.encode_chunk(2, x, "topk"))
+    assert idx == 2 and y.shape == x.shape
+    kk = max(1, int(np.ceil(x.size * 0.01)))
+    sel = np.nonzero(y)[0]
+    assert len(sel) == kk and 37 in sel
+    np.testing.assert_array_equal(y[sel], x[sel])  # selected travel exact
+    # header + (nelems, kk) + kk * (i32 index + f32 value)
+    assert C.wire_bytes(1000, "topk") == 5 + 8 + 8 * kk
+    assert len(C.encode_chunk(0, x, "topk")) == C.wire_bytes(x.size, "topk")
+    # non-finite elements are force-selected (sort magnitude becomes inf)
+    x[5] = np.nan
+    _, y = C.decode_chunk(C.encode_chunk(0, x, "topk"))
+    assert not np.isfinite(y[5])
+
+
 # ---- ring collectives over in-memory rings --------------------------------
 
 
@@ -256,6 +294,38 @@ def test_hierarchical_allreduce_compressed_dcn_within_tolerance():
         # and the compression must actually cost SOMETHING measurable —
         # a bound so loose it never binds would prove nothing
         assert float(np.max(np.abs(out - expected))) > 0.0
+
+
+@pytest.mark.parametrize("dcn_codec", ["onebit_ef", "topk"])
+def test_hierarchical_allreduce_lossy_dcn_transport_integrity(dcn_codec):
+    """The sign/sparse wire models through the full two-level
+    construction: the stateless mirror carries no error-feedback
+    residual, so the assertion is transport integrity — frames
+    reassemble in order, the result stays finite and span-bounded — not
+    convergence fidelity (that is the jax path's EF contract)."""
+    intra, inter, n = 4, 2, 512
+    world = intra * inter
+    vecs = [np.random.default_rng([5, r]).uniform(-1, 1, n)
+            .astype(np.float32) for r in range(world)]
+    expected = np.mean(vecs, axis=0)
+    intra_rings = [_MemRing(intra) for _ in range(inter)]
+    inter_rings = [_MemRing(inter) for _ in range(intra)]
+
+    def run(rank):
+        s, p = rank // intra, rank % intra
+        out, hops = C.hierarchical_allreduce(
+            vecs[rank],
+            intra_rings[s].hop_fn(p), p, intra,
+            inter_rings[p].hop_fn(s), s, inter,
+            dcn_codec=dcn_codec,
+        )
+        assert hops["inter_hops"] == 2 * (inter - 1)
+        return out
+
+    atol = C.quantization_atol(2.0 * intra, 2 * (inter - 1), dcn_codec)
+    for out in _run_world(world, run):
+        assert np.isfinite(out).all()
+        assert float(np.max(np.abs(out - expected))) <= atol
 
 
 def test_hierarchical_allreduce_f32_everywhere_is_exact():
