@@ -11,6 +11,13 @@ Rust scheduler driving NCCL streams.
 from .version import __version__  # noqa: F401
 
 from . import env  # noqa: F401
+
+# the lockdep witness must wrap the lock factories BEFORE the imports below
+# create the package's module-level locks (no-op unless BAGUA_LOCKDEP=on)
+from .analysis import lockdep as _lockdep
+
+_lockdep.maybe_install()
+
 from .communication import (  # noqa: F401
     BaguaAborted,
     BaguaBackend,

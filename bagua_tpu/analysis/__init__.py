@@ -18,6 +18,17 @@ subsystem catches that hazard class statically:
   calls in traced code, raw ``BAGUA_*`` env reads outside the registry,
   tracer leakage onto ``self``, nondeterministic Python RNG in traced code,
   copy-pasted helper lambdas, and torch imports.
+* :mod:`.concurrency` — a whole-program host-concurrency model (thread
+  roots, lock acquisition graph, shared mutable state): lock-order
+  inversions, unguarded shared writes, IO under contended locks,
+  signal-unsafe locking, non-reentrant re-acquisition.
+* :mod:`.trace_coherence` — the step-cache-key coherence prover: every env
+  knob or autotune-mutable trainer attribute that shapes the traced step
+  must ride ``BaguaTrainer._step_key`` (or carry an explicit
+  ``# bagua: trace-invariant[name] -- reason`` annotation).
+* :mod:`.lockdep` — an opt-in (``BAGUA_LOCKDEP=on``) runtime witness that
+  records real lock acquisition orders and is cross-checked against the
+  static graph by ``bagua-lint --witness``.
 
 Run as a CLI (``python -m bagua_tpu.analysis bagua_tpu/`` — the CI gate,
 see ``scripts/ci.sh``) or through pytest (``tests/test_analysis.py``).
@@ -31,12 +42,30 @@ The jaxpr checker imports jax lazily.
 
 from .findings import Finding, load_baseline, save_baseline  # noqa: F401
 from .ast_rules import RULES, run_ast_rules  # noqa: F401
-from .suppressions import parse_suppressions  # noqa: F401
+from .suppressions import KNOWN_RULE_IDS, parse_suppressions  # noqa: F401
+from .concurrency import (  # noqa: F401
+    CONCURRENCY_RULES,
+    build_program,
+    run_concurrency_rules,
+    static_lock_graph,
+)
+from .trace_coherence import TRACE_RULES, run_trace_coherence  # noqa: F401
+from .lockdep import LOCKDEP_RULES, cross_check, load_witness  # noqa: F401
 
 __all__ = [
     "Finding",
     "RULES",
+    "CONCURRENCY_RULES",
+    "TRACE_RULES",
+    "LOCKDEP_RULES",
+    "KNOWN_RULE_IDS",
     "run_ast_rules",
+    "run_concurrency_rules",
+    "run_trace_coherence",
+    "build_program",
+    "static_lock_graph",
+    "cross_check",
+    "load_witness",
     "parse_suppressions",
     "load_baseline",
     "save_baseline",

@@ -1,10 +1,21 @@
 """``bagua-lint`` CLI: ``python -m bagua_tpu.analysis [paths...]``.
 
-Runs the AST rule engine over the given paths (default: the installed
-``bagua_tpu`` package) and the jaxpr collective-consistency sweep over the
-algorithm families, compares against the shrink-only baseline, and exits
-non-zero on any unsuppressed, unbaselined finding — the CI gate wired into
-``scripts/ci.sh``.
+Runs the selected engines (``--engine ast,jaxpr,concurrency,trace`` —
+default all) over the given paths (default: the installed ``bagua_tpu``
+package), compares against the shrink-only baseline, and exits non-zero on
+any unsuppressed, unbaselined finding — the CI gate wired into
+``scripts/ci.sh``:
+
+* ``ast`` — per-module hot-path hygiene rules;
+* ``jaxpr`` — the collective-consistency sweep over the algorithm families;
+* ``concurrency`` — the whole-program host-concurrency race detector
+  (lock-order inversions, unguarded shared writes, lock-held IO, …);
+* ``trace`` — the step-cache-key coherence prover.
+
+``--witness FILE`` additionally cross-checks a runtime lockdep witness
+(produced by a ``BAGUA_LOCKDEP=on`` run) against the static acquisition
+graph: zero runtime inversions and no witnessed edge the static model
+misses.
 
 The jaxpr sweep needs a device mesh; the CLI forces the same 8-way virtual
 CPU mesh the test harness uses (``xla_force_host_platform_device_count``),
@@ -45,6 +56,22 @@ def _default_paths() -> List[str]:
     return [pkg]
 
 
+_ENGINES = ("ast", "jaxpr", "concurrency", "trace")
+
+
+def _parse_engines(spec: str) -> List[str]:
+    names = [e.strip() for e in spec.split(",") if e.strip()]
+    if "all" in names:
+        return list(_ENGINES)
+    bad = [e for e in names if e not in _ENGINES]
+    if bad:
+        raise SystemExit(
+            f"bagua-lint: unknown engine(s) {', '.join(bad)} "
+            f"(choose from {', '.join(_ENGINES)}, or 'all')"
+        )
+    return names
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         "python -m bagua_tpu.analysis",
@@ -59,10 +86,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline with the current findings "
                          "(shrink-only workflow: run after fixing entries)")
+    ap.add_argument("--engine", default="all",
+                    help="comma-separated engines to run: "
+                         f"{','.join(_ENGINES)} or 'all' (default)")
     ap.add_argument("--no-jaxpr", action="store_true",
-                    help="skip the jaxpr consistency sweep (AST rules only)")
+                    help="skip the jaxpr consistency sweep (alias for "
+                         "removing 'jaxpr' from --engine)")
     ap.add_argument("--jaxpr-only", action="store_true",
-                    help="run only the jaxpr consistency sweep")
+                    help="run only the jaxpr consistency sweep (alias for "
+                         "--engine jaxpr)")
+    ap.add_argument("--witness", default=None, metavar="FILE",
+                    help="runtime lockdep witness JSON (from a "
+                         "BAGUA_LOCKDEP=on run) to cross-check against "
+                         "the static lock graph")
     ap.add_argument("--families", default=None,
                     help="comma-separated algorithm families for the jaxpr "
                          "sweep; a ':hier' suffix traces the hierarchical "
@@ -79,10 +115,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for r in RULES:
-            print(f"{r.id}: {r.summary}")
-            print(f"    why:  {r.rationale}")
-            print(f"    hint: {r.hint}")
+        from .concurrency import CONCURRENCY_RULES
+        from .lockdep import LOCKDEP_RULES
+        from .trace_coherence import TRACE_RULES
+
+        for title, rules in (
+            ("ast", RULES),
+            ("concurrency", CONCURRENCY_RULES),
+            ("trace", TRACE_RULES),
+            ("lockdep witness", LOCKDEP_RULES),
+        ):
+            print(f"-- {title} --")
+            for r in rules:
+                print(f"{r.id}: {r.summary}")
+                print(f"    why:  {r.rationale}")
+                print(f"    hint: {r.hint}")
+        print("-- jaxpr --")
         print("cond-collective-divergence: cond/switch branches issue "
               "different collective sequences (jaxpr checker)")
         print("unbound-mesh-axis: collective axis not bound on the declared "
@@ -92,13 +140,44 @@ def main(argv: Optional[List[str]] = None) -> int:
               "(jaxpr checker)")
         return 0
 
-    findings: List[Finding] = []
+    engines = _parse_engines(args.engine)
+    if args.jaxpr_only:
+        engines = ["jaxpr"]
+    if args.no_jaxpr:
+        engines = [e for e in engines if e != "jaxpr"]
 
-    if not args.jaxpr_only:
-        paths = args.paths or _default_paths()
+    findings: List[Finding] = []
+    paths = args.paths or _default_paths()
+
+    if "ast" in engines:
         findings.extend(run_ast_rules(paths))
 
-    if not args.no_jaxpr:
+    program = None
+    if "concurrency" in engines or "trace" in engines or args.witness:
+        from .concurrency import build_program
+
+        program = build_program(paths)
+
+    if "concurrency" in engines:
+        from .concurrency import run_concurrency_rules
+
+        findings.extend(run_concurrency_rules(program=program))
+
+    if "trace" in engines:
+        from .trace_coherence import run_trace_coherence
+
+        findings.extend(run_trace_coherence(program=program))
+
+    if args.witness:
+        from .concurrency import static_lock_graph
+        from .lockdep import cross_check, load_witness
+
+        findings.extend(
+            cross_check(load_witness(args.witness),
+                        static_lock_graph(program))
+        )
+
+    if "jaxpr" in engines:
         _ensure_cpu_sim()
         from .jaxpr_check import (
             DEFAULT_ACCUM_STEPS,

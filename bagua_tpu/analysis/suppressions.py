@@ -21,6 +21,33 @@ _SUPPRESS_RE = re.compile(
     r"#\s*bagua:\s*lint-ignore\[([^\]]*)\]\s*(?:--\s*(\S.*))?"
 )
 
+#: every rule id any engine can emit (AST, jaxpr, concurrency, trace
+#: coherence, lockdep witness) plus the ``*`` wildcard.  A suppression
+#: naming anything else is dead weight — usually a typo'd or renamed rule
+#: silently suppressing nothing — and is reported as ``bad-suppression``.
+#: Kept as a literal so this module stays dependency-free;
+#: ``tests/test_analysis.py`` asserts it equals the union of the engine
+#: catalogs.
+KNOWN_RULE_IDS: FrozenSet[str] = frozenset({
+    "*",
+    # ast_rules
+    "host-sync-in-trace", "raw-env-read", "tracer-leak", "py-rng-in-trace",
+    "dup-lambda", "per-step-reflatten", "unregistered-counter",
+    "torch-import",
+    # jaxpr_check
+    "cond-collective-divergence", "unbound-mesh-axis",
+    "overlap-serialized-divergence",
+    # concurrency
+    "lock-order-inversion", "unguarded-shared-write", "lock-held-io",
+    "signal-unsafe-lock", "non-reentrant-reacquire",
+    # trace_coherence
+    "trace-knob-not-keyed", "bad-trace-invariant",
+    # lockdep
+    "lockdep-runtime-inversion", "lockdep-unmodeled-edge",
+    # the suppression machinery's own rule
+    "bad-suppression",
+})
+
 
 def parse_suppressions(
     path: str, source: str
@@ -60,6 +87,24 @@ def parse_suppressions(
                     text=tok.line.strip(),
                 ))
                 continue
+            unknown = rules - KNOWN_RULE_IDS
+            if unknown:
+                problems.append(Finding(
+                    rule="bad-suppression",
+                    path=path,
+                    line=row,
+                    message=(
+                        "lint-ignore names unknown rule id(s) "
+                        f"{', '.join(sorted(unknown))}: the suppression "
+                        "suppresses nothing"
+                    ),
+                    hint="use ids from `python -m bagua_tpu.analysis "
+                         "--list-rules` (or `*`)",
+                    text=tok.line.strip(),
+                ))
+                rules -= unknown
+                if not rules:
+                    continue
             if tok.line[: tok.start[1]].strip():
                 # trailing comment: covers its own line
                 by_line.setdefault(row, set()).update(rules)

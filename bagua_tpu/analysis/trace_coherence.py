@@ -1,0 +1,424 @@
+"""Step-cache-key coherence prover (bagua-lint v2).
+
+The trainer caches compiled step functions by ``BaguaTrainer._step_key()``
+(core/backend.py): every knob that changes the TRACED program must appear in
+that key, or a knob flip silently reuses a stale compiled step — the wrong
+program running at full speed.  PR 17's drive-found bug was exactly this
+class: ``BAGUA_TOPK_RATIO`` was read once at import time by the codec
+singleton, so a value set before trainer construction never reached the key
+and the compiled payload shapes froze at the registry default.
+
+This engine proves key coherence statically.  It enumerates the knob
+sources that can change the traced program *after* trainer construction:
+
+* **env accessors** reached by the step-construction closure (environment
+  variables can flip between steps — tests and the autotune service do);
+* **trainer attributes mutated by the autotune recommendation path**
+  (``_apply_recommendation`` and the methods it calls) that the closure
+  reads.  Constructor-frozen attributes are trace-invariant by construction
+  — the step cache lives on the trainer instance, so a value fixed at
+  ``__init__`` can never go stale — and are exempt without annotation.
+
+It then extracts the key composition from ``_step_key`` (expanding the
+helper methods it calls, e.g. ``_overlap_active``) and reports
+``trace-knob-not-keyed`` for every knob source that reaches traced-step
+construction without riding the key.  Knobs that genuinely do not alter the
+traced program (host-side wiring the closure over-approximates into scope)
+carry an explicit annotation::
+
+    self.thing = env.get_thing()  # bagua: trace-invariant[get_thing] -- why
+
+The annotation names an env accessor, the raw ``BAGUA_*`` variable, or the
+attribute; like lint suppressions, the ``-- reason`` is mandatory
+(``bad-trace-invariant`` otherwise).  The anchor class is located
+structurally — the class defining both ``_step_key`` and ``_make_step_fn``
+— so the engine runs unchanged on synthetic fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .ast_rules import Rule, _dotted
+from .findings import Finding
+from .concurrency import (
+    _METHOD_STOPLIST,
+    FuncInfo,
+    Program,
+    build_program,
+)
+from .suppressions import is_suppressed
+
+#: ``# bagua: trace-invariant[name] -- reason``
+_ANNOT_RE = re.compile(
+    r"#\s*bagua:\s*trace-invariant\[([^\]]*)\]\s*(?:--\s*(\S.*))?"
+)
+
+#: the typed read primitives of the env registry: a module function whose
+#: body calls one of these with a BAGUA_* literal is an env accessor
+_ENV_PRIMITIVES = frozenset({
+    "env_int", "env_float", "env_bool", "env_enum", "env_str",
+    "env_seconds_or_off", "_raw",
+})
+
+#: modules the step-construction closure does NOT follow into: the
+#: observability/coordination planes are host-side by construction (their
+#: env knobs shape exporters and watchdogs, never the traced program), and
+#: following them would drag every BAGUA_OBS_* accessor into scope
+_PRUNE_SEGMENTS = (
+    "/obs/", "/elastic/", "/serve/", "/service/",
+    "telemetry.py", "watchdog.py", "autopilot",
+)
+
+#: expansion cap for unresolved attribute calls — a method name defined on
+#: more than this many classes is too ambiguous to follow
+_FALLBACK_FANOUT_CAP = 8
+
+
+def _pruned(path: str) -> bool:
+    return any(seg in path or path.endswith(seg.lstrip("/"))
+               for seg in _PRUNE_SEGMENTS)
+
+
+# ---- annotations -----------------------------------------------------------
+
+
+def collect_annotations(
+    p: Program,
+) -> Tuple[Set[str], List[Finding]]:
+    """Scan every module for trace-invariant annotations.  Returns the set
+    of annotated names and the malformed-annotation findings."""
+    names: Set[str] = set()
+    problems: List[Finding] = []
+    for path, mod in p.modules.items():
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(mod.source).readline))
+        except (tokenize.TokenizeError, SyntaxError, IndentationError):
+            continue
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ANNOT_RE.search(tok.string)
+            if not m:
+                continue
+            lineno, line = tok.start[0], tok.line.rstrip("\n")
+            declared = {n.strip() for n in m.group(1).split(",")
+                        if n.strip()}
+            reason = (m.group(2) or "").strip()
+            if not declared or not reason:
+                problems.append(Finding(
+                    rule="bad-trace-invariant", path=path, line=lineno,
+                    message="malformed trace-invariant: need at least one "
+                            "knob name and a `-- reason`",
+                    hint="write `# bagua: trace-invariant[name] -- why "
+                         "this knob cannot change the traced program`",
+                    text=line.strip(),
+                ))
+                continue
+            names.update(declared)
+    return names, problems
+
+
+# ---- env accessor discovery ------------------------------------------------
+
+
+def _env_accessors(p: Program) -> Dict[str, str]:
+    """qualname of accessor function -> BAGUA_* variable it reads."""
+    out: Dict[str, str] = {}
+    for q, fn in p.funcs.items():
+        if fn.cls is not None or q != f"{fn.path}::{fn.name}":
+            continue
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None or d.split(".")[-1] not in _ENV_PRIMITIVES:
+                continue
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value.startswith("BAGUA_"):
+                    out[q] = arg.value
+    return out
+
+
+# ---- anchor class ----------------------------------------------------------
+
+
+def _find_anchor(p: Program) -> Optional[Tuple[str, str]]:
+    """(module path, class name) of the class defining both ``_step_key``
+    and ``_make_step_fn``."""
+    for path, mod in p.modules.items():
+        for cls, methods in mod.class_methods.items():
+            if "_step_key" in methods and "_make_step_fn" in methods:
+                return path, cls
+    return None
+
+
+def _class_closure(p: Program, path: str, cls: str,
+                   start: str) -> Set[str]:
+    """Transitive same-class method closure from one method (used to
+    expand ``_step_key``'s helpers and ``_apply_recommendation``'s)."""
+    prefix = f"{path}::{cls}."
+    seen: Set[str] = set()
+    stack = [start]
+    while stack:
+        q = stack.pop()
+        if q in seen or q not in p.funcs:
+            continue
+        seen.add(q)
+        for callee in p.callees.get(q, ()):
+            if callee.startswith(prefix):
+                stack.append(callee)
+    return seen
+
+
+def _self_attr_reads(fn: FuncInfo) -> Set[str]:
+    """Dotted ``self.X`` / ``self.X.Y`` attribute paths loaded in a
+    method (depth 2)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Attribute):
+            continue
+        parts: List[str] = []
+        cur: ast.AST = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name) and cur.id == "self" and parts:
+            parts.reverse()
+            out.add(parts[0])
+            if len(parts) >= 2:
+                out.add(".".join(parts[:2]))
+    return out
+
+
+def _self_attr_writes(fn: FuncInfo) -> Set[Tuple[str, int]]:
+    """(dotted attr path, line) for ``self.X[.Y] = ...`` assignments,
+    including ``setattr(self, "X", ...)`` with a literal name."""
+    out: Set[Tuple[str, int]] = set()
+    for node in ast.walk(fn.node):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Call) and \
+                _dotted(node.func) == "setattr" and \
+                len(node.args) >= 2 and \
+                isinstance(node.args[0], ast.Name) and \
+                node.args[0].id == "self" and \
+                isinstance(node.args[1], ast.Constant) and \
+                isinstance(node.args[1].value, str):
+            out.add((node.args[1].value, node.lineno))
+            continue
+        for t in targets:
+            parts: List[str] = []
+            cur: ast.AST = t
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name) and cur.id == "self" and parts:
+                parts.reverse()
+                out.add((".".join(parts[:2]), t.lineno))
+    return out
+
+
+# ---- the step-construction closure -----------------------------------------
+
+
+def _construction_closure(
+    p: Program, start: str,
+) -> Dict[str, Tuple[str, int, str]]:
+    """BFS from ``_make_step_fn`` over the call graph, with the
+    trace-engine extras: unresolved attribute calls expand to every
+    same-named method (capped), and pruned host-side modules are not
+    followed.  Returns {qualname: (witness path, line, chain)}."""
+    seen: Dict[str, Tuple[str, int, str]] = {}
+    fn0 = p.funcs[start]
+    queue: List[Tuple[str, str]] = [(start, fn0.name)]
+    seen[start] = (fn0.path, fn0.line, fn0.name)
+    while queue:
+        q, chain = queue.pop(0)
+        fn = p.funcs[q]
+        for ev in fn.events:
+            if ev.kind != "call":
+                continue
+            targets = list(ev.targets)
+            if not targets and ev.desc and \
+                    ev.desc not in _METHOD_STOPLIST and len(ev.desc) >= 4:
+                hits = p.method_index.get(ev.desc, [])
+                if 1 <= len(hits) <= _FALLBACK_FANOUT_CAP:
+                    targets = hits
+            for t in targets:
+                if t in seen or t not in p.funcs:
+                    continue
+                tf = p.funcs[t]
+                if _pruned(tf.path):
+                    continue
+                link = f"{chain} -> {tf.name}"
+                seen[t] = (fn.path, ev.line, link)
+                queue.append((t, link))
+    return seen
+
+
+# ---- engine ----------------------------------------------------------------
+
+
+def run_trace_coherence(
+    paths: Optional[Iterable[str]] = None,
+    rel_to: Optional[str] = None,
+    program: Optional[Program] = None,
+    sources: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    if program is None:
+        program = build_program(paths, rel_to=rel_to, sources=sources)
+    findings = _raw_trace_findings(program)
+    out: List[Finding] = []
+    for f in findings:
+        if not is_suppressed(f, program.suppressions.get(f.path, {})):
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def _raw_trace_findings(p: Program) -> List[Finding]:
+    annotated, findings = collect_annotations(p)
+    anchor = _find_anchor(p)
+    if anchor is None:
+        return findings
+    path, cls = anchor
+    prefix = f"{path}::{cls}."
+    accessors = _env_accessors(p)
+
+    # -- the key composition.  Attribute coverage comes from _step_key and
+    # its same-class helpers; env coverage is INTERPROCEDURAL (the same BFS
+    # as the construction closure) because a knob can ride the key through
+    # a helper's value — e.g. armed fault-spec signatures derived from the
+    # BAGUA_FAULT_PLAN read inside faults/inject.
+    key_attrs: Set[str] = set()
+    key_env: Set[str] = set()
+    for q in _class_closure(p, path, cls, f"{prefix}_step_key"):
+        key_attrs |= _self_attr_reads(p.funcs[q])
+    for q in _construction_closure(p, f"{prefix}_step_key"):
+        fn = p.funcs.get(q)
+        if fn is None:
+            continue
+        for ev in fn.events:
+            if ev.kind == "call":
+                key_env.update(t for t in ev.targets if t in accessors)
+
+    # -- the step-construction closure
+    closure = _construction_closure(p, f"{prefix}_make_step_fn")
+
+    # env accessors the closure reaches
+    env_hits: Dict[str, Tuple[str, int, str]] = {}
+    for q, (wpath, wline, chain) in closure.items():
+        for ev in p.funcs[q].events:
+            if ev.kind != "call":
+                continue
+            for t in ev.targets:
+                if t in accessors and t not in env_hits:
+                    env_hits[t] = (p.funcs[q].path, ev.line,
+                                   f"{chain} -> {p.funcs[t].name}")
+
+    rule = _rule("trace-knob-not-keyed")
+    for acc, (wpath, wline, chain) in sorted(env_hits.items()):
+        if acc in key_env:
+            continue
+        var = accessors[acc]
+        acc_name = p.funcs[acc].name
+        if {var, acc_name} & annotated:
+            continue
+        findings.append(Finding(
+            rule=rule.id, path=wpath, line=wline,
+            message=f"{var} (via {acc_name}) feeds traced-step "
+                    f"construction ({chain}) but does not ride "
+                    "_step_key: an env flip reuses a stale compiled "
+                    "step",
+            hint=rule.hint,
+            text=_line_text(p, wpath, wline),
+        ))
+
+    # -- mutable trainer attrs: the autotune recommendation path
+    rec = f"{prefix}_apply_recommendation"
+    mutable: Dict[str, Tuple[str, int]] = {}
+    if rec in p.funcs:
+        for q in _class_closure(p, path, cls, rec):
+            for attr, line in _self_attr_writes(p.funcs[q]):
+                mutable.setdefault(attr, (p.funcs[q].path, line))
+
+    # attrs the construction closure reads (anchor-class methods only)
+    closure_attrs: Set[str] = set()
+    for q in closure:
+        if q.startswith(prefix):
+            closure_attrs |= _self_attr_reads(p.funcs[q])
+
+    for attr, (wpath, wline) in sorted(mutable.items()):
+        base = attr.split(".")[0]
+        if attr not in closure_attrs and base not in closure_attrs:
+            continue  # mutated but never read during step construction
+        if attr in key_attrs or (("." in attr) and base in key_attrs):
+            continue
+        if {attr, base} & annotated:
+            continue
+        findings.append(Finding(
+            rule=rule.id, path=wpath, line=wline,
+            message=f"self.{attr} is mutated by the autotune "
+                    "recommendation path and read during traced-step "
+                    "construction but does not ride _step_key: the "
+                    "recommendation silently reuses a stale compiled "
+                    "step",
+            hint=rule.hint,
+            text=_line_text(p, wpath, wline),
+        ))
+
+    return findings
+
+
+def _line_text(p: Program, path: str, line: int) -> str:
+    mod = p.modules.get(path)
+    if mod is None:
+        return ""
+    lines = mod.source.splitlines()
+    return lines[line - 1].strip() if 1 <= line <= len(lines) else ""
+
+
+def _rule(rule_id: str) -> Rule:
+    for r in TRACE_RULES:
+        if r.id == rule_id:
+            return r
+    raise KeyError(rule_id)
+
+
+TRACE_RULES: List[Rule] = [
+    Rule(
+        id="trace-knob-not-keyed",
+        summary="a knob (env accessor or autotune-mutable trainer attr) "
+                "feeds traced-step construction but is absent from "
+                "_step_key",
+        rationale="The step cache returns a compiled program for the key; "
+                  "a knob that shapes the trace without riding the key "
+                  "means a flip reuses a stale program — the PR 17 "
+                  "BAGUA_TOPK_RATIO freeze, where changed payload shapes "
+                  "never retraced.",
+        hint="add the knob (or the value derived from it) to _step_key, "
+             "or annotate the read site `# bagua: trace-invariant[name] "
+             "-- reason` if it provably cannot alter the traced program",
+    ),
+    Rule(
+        id="bad-trace-invariant",
+        summary="malformed trace-invariant annotation (missing knob name "
+                "or `-- reason`)",
+        rationale="An unexplained invariant claim is indistinguishable "
+                  "from silencing the prover; the reason is the review "
+                  "surface.",
+        hint="write `# bagua: trace-invariant[name] -- why this knob "
+             "cannot change the traced program`",
+    ),
+]

@@ -81,7 +81,11 @@ def abort(reason: str = "user abort") -> None:
     """Flag every communicator as aborted; in-flight XLA programs finish
     (they cannot be cancelled) but no new communication is dispatched."""
     global _ABORT_REASON
-    _ABORT_REASON = reason
+    # lock-free by design: the Event is the sync point (reason is written
+    # before set(), so a reader that saw the event sees the reason), the
+    # store is a single GIL-atomic ref assignment, and check_abort
+    # tolerates a torn read with its `or "aborted"` fallback
+    _ABORT_REASON = reason  # bagua: lint-ignore[unguarded-shared-write] -- Event-published; GIL-atomic store; stale read falls back to "aborted"
     _ABORT_EVENT.set()
     from .telemetry import counters
 

@@ -127,6 +127,18 @@ _declare("BAGUA_COORDINATOR_ADDR", "str", "",
 _declare("BAGUA_COMM_TIMEOUT_S", "str", "300",
          "Hang-watchdog timeout for watched collectives, in seconds; "
          "``0``/``off``/``false``/``none`` disables the watchdog.")
+_declare("BAGUA_LOCKDEP", "enum", "off",
+         "Runtime lockdep witness (bagua-lint v2, docs/analysis.md): `on` "
+         "wraps every lock the package creates so real acquisition orders "
+         "are recorded and opposite-order pairs (live deadlock windows) "
+         "are detected; the witness JSON is cross-checked against the "
+         "static concurrency engine's graph in CI.  Diagnostics only — "
+         "adds per-acquisition bookkeeping, keep `off` in production.",
+         choices=("off", "on"))
+_declare("BAGUA_LOCKDEP_OUT", "str", "",
+         "Output path for the lockdep witness JSON (edges, inversions, "
+         "per-site acquisition counts), written at process exit.  Empty "
+         "falls back to ./bagua_lockdep_witness.json.")
 # -- robustness / fault handling --
 _declare("BAGUA_GRAD_GUARD", "enum", "off",
          "Gradient-health sentinel policy: per-bucket isfinite checks on "
@@ -695,6 +707,19 @@ def get_comm_timeout_s() -> Optional[float]:
     registry-backed accessor behind
     :func:`bagua_tpu.watchdog.get_comm_timeout_s`."""
     return env_seconds_or_off("BAGUA_COMM_TIMEOUT_S")
+
+
+def get_lockdep_mode() -> str:
+    """Runtime lockdep witness: ``off`` (default) or ``on``.  Read once at
+    package import (the shim must wrap locks as they are created), so it
+    can only be set in the environment, never flipped at runtime."""
+    return env_enum("BAGUA_LOCKDEP")
+
+
+def get_lockdep_out() -> str:
+    """Lockdep witness JSON output path ("" = the default
+    ``./bagua_lockdep_witness.json``)."""
+    return env_str("BAGUA_LOCKDEP_OUT")
 
 
 def get_grad_guard_mode() -> str:

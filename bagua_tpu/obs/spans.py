@@ -63,7 +63,8 @@ def set_enabled(value: Optional[bool]) -> None:
     """Override the cached switch (tests); ``None`` re-reads ``BAGUA_OBS``
     on the next :func:`enabled` call."""
     global _ENABLED
-    _ENABLED = value
+    with _ENABLED_LOCK:
+        _ENABLED = value
 
 
 def _cached_rank() -> int:

@@ -11,7 +11,12 @@ cd "$(dirname "$0")/.."
 echo "=== lint (syntax) ==="
 python -m compileall -q bagua_tpu tests examples bench.py __graft_entry__.py
 
-echo "=== bagua-lint (AST rules + jaxpr collective consistency) ==="
+echo "=== bagua-lint (AST + jaxpr + concurrency + trace-coherence engines) ==="
+# All four engines (--engine all is the default): AST hot-path rules, the
+# jaxpr collective-consistency sweep, the host-concurrency race detector
+# (lock-order inversions, unguarded shared writes, lock-held IO,
+# signal-unsafe locking), and the step-cache-key coherence prover (every
+# knob that shapes the traced step must ride _step_key; ISSUE 18).
 # Fails on any unsuppressed finding not in the shrink-only baseline (stale
 # baseline entries fail too — the baseline can only shrink), and proves
 # overlap-vs-serialized collective-multiset equality for the algorithm
@@ -40,6 +45,23 @@ OBS_TMP="$(mktemp -d)"
 BAGUA_OBS_EXPORT_DIR="$OBS_TMP/export" BAGUA_OBS_EXPORT_INTERVAL_S=1 \
 python scripts/chaos_drill.py --only nan_grad_skip_loss_continuity \
   --dump-dir "$OBS_TMP/dumps"
+
+echo "=== lockdep witness (chaos smoke under BAGUA_LOCKDEP=on) ==="
+# The same drill re-run with the runtime lockdep shim recording every real
+# lock acquisition order, then cross-checked against the static
+# acquisition graph: zero runtime inversions (a live deadlock window the
+# drill actually exercised) and every witnessed edge between known locks
+# present in the static model (witness ⊆ static — the concurrency
+# engine's 'no cycle' verdicts are only trustworthy if it saw every real
+# ordering).  See docs/analysis.md, ISSUE 18.
+BAGUA_LOCKDEP=on BAGUA_LOCKDEP_OUT="$OBS_TMP/lockdep_witness.json" \
+BAGUA_OBS_EXPORT_DIR="$OBS_TMP/export2" BAGUA_OBS_EXPORT_INTERVAL_S=1 \
+python scripts/chaos_drill.py --only nan_grad_skip_loss_continuity \
+  --dump-dir "$OBS_TMP/dumps2"
+JAX_PLATFORMS=cpu \
+python -m bagua_tpu.analysis bagua_tpu/ --engine concurrency \
+  --witness "$OBS_TMP/lockdep_witness.json" \
+  --baseline .bagua-lint-baseline.json
 
 echo "=== obs HTTP plane smoke (live /metrics + /fleet scrape) ==="
 # The HTTP status plane scraped DURING a live cpu-sim training run: the

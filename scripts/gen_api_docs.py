@@ -104,6 +104,9 @@ MODULES = [
     "bagua_tpu.analysis.jaxpr_check",
     "bagua_tpu.analysis.findings",
     "bagua_tpu.analysis.suppressions",
+    "bagua_tpu.analysis.concurrency",
+    "bagua_tpu.analysis.trace_coherence",
+    "bagua_tpu.analysis.lockdep",
     "bagua_tpu.define",
     "bagua_tpu.utils",
 ]

@@ -506,3 +506,540 @@ def test_multiset_ignores_order_but_not_shape():
     c = _trace_shard_map(lambda x: jax.lax.psum(x[:, :2], "dp"))
     sc, _ = collect(c)
     assert multiset(sa) != multiset(sc)  # shape is part of the signature
+
+
+# ---- concurrency engine (bagua-lint v2) -----------------------------------
+
+
+from bagua_tpu.analysis.concurrency import (  # noqa: E402
+    build_program,
+    run_concurrency_rules,
+    static_lock_graph,
+)
+from bagua_tpu.analysis.trace_coherence import run_trace_coherence  # noqa: E402
+from bagua_tpu.analysis import lockdep as lockdep_mod  # noqa: E402
+from bagua_tpu.analysis.suppressions import KNOWN_RULE_IDS  # noqa: E402
+
+import threading  # noqa: E402
+
+
+def _fx(**files):
+    """name -> dedented source; underscores in kwargs become path slashes."""
+    return {k.replace("__", "/") + ".py": textwrap.dedent(v)
+            for k, v in files.items()}
+
+
+def conc_rules(sources):
+    return [f.rule for f in run_concurrency_rules(sources=sources)]
+
+
+def test_lock_order_inversion_positive():
+    rules = conc_rules(_fx(fx__mod="""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def forward():
+            with A:
+                with B:
+                    pass
+
+        def backward():
+            with B:
+                with A:
+                    pass
+
+        def start():
+            threading.Thread(target=backward).start()
+    """))
+    assert "lock-order-inversion" in rules
+
+
+def test_lock_order_consistent_negative():
+    rules = conc_rules(_fx(fx__mod="""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def forward():
+            with A:
+                with B:
+                    pass
+
+        def also_forward():
+            with A:
+                with B:
+                    pass
+
+        def start():
+            threading.Thread(target=also_forward).start()
+    """))
+    assert "lock-order-inversion" not in rules
+
+
+def test_unguarded_shared_write_positive():
+    """The pre-fix obs/spans.py shape: init under the lock, the test
+    override without it."""
+    rules = conc_rules(_fx(fx__mod="""
+        import threading
+        _STATE = None
+        _LOCK = threading.Lock()
+
+        def init():
+            global _STATE
+            with _LOCK:
+                _STATE = 1
+
+        def override(v):
+            global _STATE
+            _STATE = v
+
+        def bg():
+            init()
+
+        def start():
+            threading.Thread(target=bg).start()
+    """))
+    assert "unguarded-shared-write" in rules
+
+
+def test_unguarded_shared_write_common_lock_negative():
+    rules = conc_rules(_fx(fx__mod="""
+        import threading
+        _STATE = None
+        _LOCK = threading.Lock()
+
+        def init():
+            global _STATE
+            with _LOCK:
+                _STATE = 1
+
+        def override(v):
+            global _STATE
+            with _LOCK:
+                _STATE = v
+
+        def bg():
+            init()
+
+        def start():
+            threading.Thread(target=bg).start()
+    """))
+    assert "unguarded-shared-write" not in rules
+
+
+def test_unguarded_shared_write_single_root_negative():
+    """No second thread root: a module global mutated only from the main
+    context is not a race."""
+    rules = conc_rules(_fx(fx__mod="""
+        _STATE = None
+
+        def init():
+            global _STATE
+            _STATE = 1
+
+        def override(v):
+            global _STATE
+            _STATE = v
+    """))
+    assert "unguarded-shared-write" not in rules
+
+
+def test_lock_held_io_positive_and_negative():
+    src = """
+        import threading
+        import time
+        _L = threading.Lock()
+
+        def slow():
+            with _L:
+                time.sleep(1.0)
+
+        def fast():
+            with _L:
+                x = 1
+                return x
+
+        def start():
+            threading.Thread(target=slow).start()
+    """
+    assert "lock-held-io" in conc_rules(_fx(fx__mod=src))
+    # single-root: nobody contends, the IO hurts nobody
+    single = src.replace("threading.Thread(target=slow).start()", "slow()")
+    assert "lock-held-io" not in conc_rules(_fx(fx__mod=single))
+
+
+def test_signal_unsafe_lock_positive_pre_fix_sigterm_dump():
+    """The pre-fix flight-record shape: the SIGTERM handler called the
+    dump path directly, acquiring the dump lock from handler context."""
+    rules = conc_rules(_fx(fx__rec="""
+        import signal
+        import threading
+        _DUMP_LOCK = threading.Lock()
+
+        def dump_flight_record():
+            with _DUMP_LOCK:
+                pass
+
+        def _on_term(signum, frame):
+            dump_flight_record()
+
+        def install():
+            signal.signal(signal.SIGTERM, _on_term)
+    """))
+    assert "signal-unsafe-lock" in rules
+
+
+def test_signal_flag_defer_negative_post_fix_shape():
+    """The post-fix shape: the handler only sets a flag; the dump runs
+    from a normal context later."""
+    rules = conc_rules(_fx(fx__rec="""
+        import signal
+        import threading
+        _DUMP_LOCK = threading.Lock()
+        _PENDING = threading.Event()
+
+        def dump_flight_record():
+            with _DUMP_LOCK:
+                pass
+
+        def _on_term(signum, frame):
+            _PENDING.set()
+
+        def install():
+            signal.signal(signal.SIGTERM, _on_term)
+
+        def maybe_dump():
+            if _PENDING.is_set():
+                dump_flight_record()
+    """))
+    assert "signal-unsafe-lock" not in rules
+
+
+def test_non_reentrant_reacquire_positive_and_rlock_negative():
+    src = """
+        import threading
+        _L = threading.Lock()
+
+        def outer():
+            with _L:
+                inner()
+
+        def inner():
+            with _L:
+                pass
+    """
+    assert "non-reentrant-reacquire" in conc_rules(_fx(fx__mod=src))
+    rlock = src.replace("threading.Lock()", "threading.RLock()")
+    assert "non-reentrant-reacquire" not in conc_rules(_fx(fx__mod=rlock))
+
+
+def test_concurrency_suppression_applies():
+    rules = conc_rules(_fx(fx__mod="""
+        import threading
+        _STATE = None
+        _LOCK = threading.Lock()
+
+        def init():
+            global _STATE
+            with _LOCK:
+                _STATE = 1  # bagua: lint-ignore[unguarded-shared-write] -- fixture
+
+        def override(v):
+            global _STATE
+            _STATE = v
+
+        def bg():
+            init()
+
+        def start():
+            threading.Thread(target=bg).start()
+    """))
+    assert "unguarded-shared-write" not in rules
+
+
+def test_package_is_concurrency_and_trace_clean():
+    """The committed package has zero findings from both v2 engines (the
+    baseline stays empty) — and the model is NOT vacuous: it sees the
+    package's locks, thread roots, and the codec env read."""
+    p = build_program([PKG], rel_to=REPO)
+    conc = run_concurrency_rules(program=p)
+    assert conc == [], "\n".join(f.render() for f in conc)
+    trace = run_trace_coherence(program=p)
+    assert trace == [], "\n".join(f.render() for f in trace)
+    assert len(p.locks) >= 10
+    assert len(p.thread_roots) >= 5
+    g = static_lock_graph(p)
+    assert "bagua_tpu/obs/spans.py::_ENABLED_LOCK" in set(g["locks"].values())
+    # the trace prover actually followed construction into the codec
+    from bagua_tpu.analysis import trace_coherence as tc
+    closure = tc._construction_closure(
+        p, "bagua_tpu/core/backend.py::BaguaTrainer._make_step_fn")
+    assert ("bagua_tpu/compression/codecs.py::TopKCodec.__init__"
+            in closure)
+
+
+def test_spans_set_enabled_holds_the_lock():
+    """Regression for the unguarded-shared-write finding on obs/spans:
+    the test override must take the same lock as the double-checked
+    init."""
+    from bagua_tpu.obs import spans
+
+    class Probe:
+        def __init__(self):
+            self.entered = 0
+            self._l = threading.Lock()
+
+        def __enter__(self):
+            self.entered += 1
+            return self._l.__enter__()
+
+        def __exit__(self, *exc):
+            return self._l.__exit__(*exc)
+
+    probe = Probe()
+    orig_lock, orig_state = spans._ENABLED_LOCK, spans._ENABLED
+    try:
+        spans._ENABLED_LOCK = probe
+        spans.set_enabled(True)
+        assert probe.entered == 1
+        assert spans.enabled() is True
+    finally:
+        spans._ENABLED_LOCK = orig_lock
+        spans._ENABLED = orig_state
+
+
+# ---- trace-coherence engine -----------------------------------------------
+
+
+_TRACE_ENV_FX = """
+    import os
+
+    def _raw(name, default):
+        return os.environ.get(name, default)
+
+    def get_ratio():
+        return float(_raw("BAGUA_FX_RATIO", "0.01"))
+"""
+
+_TRACE_PRE_FIX = """
+    from .env import get_ratio
+
+    class Codec:
+        def __init__(self):
+            self.ratio = get_ratio()
+
+    CODECS = {"topk": Codec()}
+
+    def get_codec(name):
+        return CODECS[name]
+
+    class Trainer:
+        def __init__(self):
+            self.plan = "p"
+
+        def _step_key(self):
+            return (self.plan,)
+
+        def _make_step_fn(self):
+            return get_codec("topk")
+"""
+
+
+def trace_rules(sources):
+    return [f.rule for f in run_trace_coherence(sources=sources)]
+
+
+def test_trace_flags_import_time_env_freeze_pre_fix_shape():
+    """The PR 17 BAGUA_TOPK_RATIO bug: the codec singleton reads the env
+    var at import, the key never carries it — a flip reuses a stale
+    compiled step."""
+    found = trace_rules(_fx(fx__env=_TRACE_ENV_FX,
+                            fx__trainer=_TRACE_PRE_FIX))
+    assert "trace-knob-not-keyed" in found
+
+
+def test_trace_accepts_keyed_knob_post_fix_shape():
+    keyed = _TRACE_PRE_FIX.replace(
+        "return (self.plan,)", "return (self.plan, get_ratio())")
+    found = trace_rules(_fx(fx__env=_TRACE_ENV_FX, fx__trainer=keyed))
+    assert found == []
+
+
+def test_trace_invariant_annotation_suppresses():
+    annotated = _TRACE_PRE_FIX.replace(
+        'return get_codec("topk")',
+        'return get_codec("topk")  '
+        '# bagua: trace-invariant[BAGUA_FX_RATIO] -- fixture: host-side only',
+    )
+    found = trace_rules(_fx(fx__env=_TRACE_ENV_FX, fx__trainer=annotated))
+    assert found == []
+
+
+def test_malformed_trace_invariant_is_reported():
+    found = trace_rules(_fx(fx__mod="""
+        # bagua: trace-invariant[get_ratio]
+        X = 1
+    """))
+    assert "bad-trace-invariant" in found
+
+
+def test_trace_flags_autotune_mutable_attr_not_keyed():
+    src = """
+        class Trainer:
+            def __init__(self):
+                self.overlap = "on"
+
+            def _apply_recommendation(self, rec):
+                self.overlap = rec
+
+            def _step_key(self):
+                return (1,)
+
+            def _make_step_fn(self):
+                return self.overlap
+    """
+    assert "trace-knob-not-keyed" in trace_rules(_fx(fx__trainer=src))
+    keyed = src.replace("return (1,)", "return (self.overlap,)")
+    assert trace_rules(_fx(fx__trainer=keyed)) == []
+
+
+def test_constructor_frozen_attr_is_exempt():
+    """An attr set only in __init__ and read by construction needs no key
+    entry: the per-instance step cache cannot go stale on it."""
+    found = trace_rules(_fx(fx__trainer="""
+        class Trainer:
+            def __init__(self, donate):
+                self.donate = donate
+
+            def _apply_recommendation(self, rec):
+                pass
+
+            def _step_key(self):
+                return (1,)
+
+            def _make_step_fn(self):
+                return self.donate
+    """))
+    assert found == []
+
+
+# ---- suppression rule-id validation ----------------------------------------
+
+
+def test_unknown_rule_id_suppression_is_reported():
+    found = rules_of("""
+        import os
+        a = os.environ.get("BAGUA_FIXTURE_A")  # bagua: lint-ignore[no-such-rule] -- typo
+    """)
+    assert "bad-suppression" in found
+    assert "raw-env-read" in found  # the typo'd suppression covers nothing
+
+
+def test_known_rule_ids_match_engine_catalogs():
+    from bagua_tpu.analysis.ast_rules import RULES as AST_RULES
+    from bagua_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from bagua_tpu.analysis.lockdep import LOCKDEP_RULES
+    from bagua_tpu.analysis.trace_coherence import TRACE_RULES
+
+    ids = {r.id for r in (list(AST_RULES) + list(CONCURRENCY_RULES)
+                          + list(TRACE_RULES) + list(LOCKDEP_RULES))}
+    ids |= {"cond-collective-divergence", "unbound-mesh-axis",
+            "overlap-serialized-divergence", "bad-suppression", "*"}
+    assert ids == set(KNOWN_RULE_IDS)
+
+
+# ---- lockdep runtime witness -----------------------------------------------
+
+
+def test_lockdep_state_records_edges_and_inversions(tmp_path):
+    st = lockdep_mod._LockdepState(
+        pkg_dir="/nonexistent", out_path=str(tmp_path / "w.json"))
+    a, b = ("m.py", 1), ("m.py", 2)
+    la = lockdep_mod._InstrumentedLock(threading.Lock(), a, st)
+    lb = lockdep_mod._InstrumentedLock(threading.Lock(), b, st)
+    with la:
+        with lb:
+            pass
+    w = st.witness()
+    assert {"from": list(a), "to": list(b), "count": 1} in w["edges"]
+    assert w["inversions"] == []
+    with lb:
+        with la:
+            pass
+    w = st.witness()
+    assert len(w["inversions"]) == 1
+    st.dump()
+    assert lockdep_mod.load_witness(str(tmp_path / "w.json"))["inversions"]
+
+
+def test_lockdep_reentrant_reacquire_is_not_an_edge(tmp_path):
+    st = lockdep_mod._LockdepState(
+        pkg_dir="/nonexistent", out_path=str(tmp_path / "w.json"))
+    a = ("m.py", 1)
+    la = lockdep_mod._InstrumentedLock(threading.RLock(), a, st)
+    with la:
+        with la:
+            pass
+    w = st.witness()
+    assert w["edges"] == [] and w["inversions"] == []
+
+
+def test_lockdep_cross_check():
+    graph = {
+        "locks": {("m.py", 1): "m.py::A", ("m.py", 2): "m.py::B"},
+        "edges": {("m.py::A", "m.py::B"): "m.py:10"},
+    }
+    clean = {"edges": [{"from": ["m.py", 1], "to": ["m.py", 2],
+                        "count": 3}], "inversions": []}
+    assert lockdep_mod.cross_check(clean, graph) == []
+
+    inverted = {"edges": [], "inversions": [
+        {"a": ["m.py", 1], "b": ["m.py", 2], "thread": "t"}]}
+    assert [f.rule for f in lockdep_mod.cross_check(inverted, graph)] == \
+        ["lockdep-runtime-inversion"]
+
+    unmodeled = {"edges": [{"from": ["m.py", 2], "to": ["m.py", 1],
+                            "count": 1}], "inversions": []}
+    assert [f.rule for f in lockdep_mod.cross_check(unmodeled, graph)] == \
+        ["lockdep-unmodeled-edge"]
+
+    # locks the static model does not catalog are not a gate
+    foreign = {"edges": [{"from": ["x.py", 9], "to": ["m.py", 1],
+                          "count": 1}], "inversions": []}
+    assert lockdep_mod.cross_check(foreign, graph) == []
+
+
+def test_lockdep_not_installed_by_default():
+    assert lockdep_mod.maybe_install() is (lockdep_mod._STATE is not None)
+    # BAGUA_LOCKDEP defaults off, and nothing in the test suite turns it
+    # on for this process
+    assert lockdep_mod._STATE is None
+
+
+def test_cli_witness_gates_runtime_inversion(tmp_path):
+    import json
+
+    wit = tmp_path / "wit.json"
+    wit.write_text(json.dumps({
+        "edges": [],
+        "inversions": [{"a": ["bagua_tpu/telemetry.py", 63],
+                        "b": ["bagua_tpu/obs/spans.py", 47],
+                        "thread": "t"}],
+    }))
+    out = subprocess.run(
+        [sys.executable, "-m", "bagua_tpu.analysis", "bagua_tpu/",
+         "--engine", "concurrency", "--witness", str(wit)],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert out.returncode == 1
+    assert "lockdep-runtime-inversion" in out.stdout
+
+
+def test_cli_engine_selection_runs_v2_clean():
+    out = subprocess.run(
+        [sys.executable, "-m", "bagua_tpu.analysis", "bagua_tpu/",
+         "--engine", "concurrency,trace"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
