@@ -299,6 +299,11 @@ def default_engine_actuators(model_name: Optional[str] = None,
             # (every rank's next check-in re-jits the compressed DCN hops)
             hint["codec"] = (action.evidence or {}).get(
                 "codec") or "minmax_uint8"
+            # v2 search tasks turn the observed dominance into coordinate
+            # weighting instead of a pin (priors, not pins)
+            share = (action.evidence or {}).get("dcn_share_max")
+            if share is not None:
+                hint["dcn_share"] = share
         return deliver_hints_via_service(model, [hint], addr=autotune_addr)
 
     def _quarantine(action: Action) -> bool:

@@ -483,7 +483,11 @@ def decide(snapshot: dict, state: PolicyState, config: PolicyConfig,
                         f"(ladder rung {state.codec_rung})"),
                 evidence={"trends": dcn_items, "streak": streak,
                           "codec": codec,
-                          "codec_rung": state.codec_rung},
+                          "codec_rung": state.codec_rung,
+                          # worst observed share: the autotune v2 loop
+                          # turns it into coordinate weighting (how hard
+                          # to bias the search toward the DCN-tier knobs)
+                          "dcn_share_max": max(shares.values())},
             ), now)
             state.streaks.pop("dcn", None)
 

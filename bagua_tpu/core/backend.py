@@ -529,6 +529,10 @@ class BaguaTrainer:
         self._autotune_client = None
         self._autotune_failures = 0
         self._autotune_completed = not self.autotune
+        #: previous goodput-ledger snapshot at the last check-in: the
+        #: ledger reports CUMULATIVE seconds, the autotune score needs the
+        #: WINDOW since the last report (same windowing as the speed)
+        self._autotune_ledger_prev = None
         self._telemetry_reported = False
         self._pending_state_migration = None
         self._stashed_opt_state = None
@@ -536,6 +540,11 @@ class BaguaTrainer:
         #: generalizes the old ZeRO-only ``_zero_flat`` gate to every
         #: supports_flat_resident family
         self._flat_resident = False
+        #: whether init() has resolved + built the state layout: before
+        #: this, a flat_resident recommendation adjusts the MODE (init
+        #: builds the layout directly); after, it queues a live
+        #: flat<->leaf state migration (:meth:`_apply_flat_resident`)
+        self._flat_layout_live = False
         #: the optimizer the compiled step actually runs: the user's, or a
         #: ``fuse_optimizer`` wrapper's inner transform when the resident
         #: flats already are the fused layout (resolved at init())
@@ -1058,6 +1067,7 @@ class BaguaTrainer:
             # natively instead of re-concatenating into the wrapper's
             # private per-dtype buffers every step
             self._opt = self.optimizer.fused_inner
+        self._flat_layout_live = True
         ctx = self._ctx(plan)
         mesh = self.mesh
 
@@ -1718,6 +1728,10 @@ class BaguaTrainer:
             # fused collectives), so the raw knob values always key
             self.compress_intra,
             self.compress_inter,
+            # the state layout the step is traced against: autotune v2 can
+            # flip bucket-flat residency live (_apply_flat_resident), and
+            # the flat and leaf constructions are different programs
+            self._flat_resident,
             # grad guard: "warn" and "abort" trace the same program (the
             # policy difference is host-side), "skip" adds the rewind
             # selects; armed traced faults compile into the step, so their
@@ -2529,6 +2543,7 @@ class BaguaTrainer:
             rsp = self._autotune_client.register_tensors(
                 model_name=self.model_name,
                 tensor_list=[p.declaration().model_dump() for p in self._named_params],
+                capabilities=self._autotune_capabilities(),
             )
             # apply the service's initial recommendation so trainer and
             # service agree on the config the first score is attributed to
@@ -2540,6 +2555,64 @@ class BaguaTrainer:
         except Exception as e:  # autotune must never take down training
             logger.warning("autotune register_tensors failed: %s", e)
             self.autotune = False
+
+    def _autotune_capabilities(self) -> Optional[dict]:
+        """What this trainer's mesh / family / layout makes legal — sent
+        once at tensor registration so the service builds the
+        capability-gated v2 knob space for exactly the knobs this trainer
+        can apply (a knob the trainer would refuse is never searched).
+        ``None`` keeps the legacy two-knob space
+        (``BAGUA_AUTOTUNE_SPACE=legacy``)."""
+        if env.get_autotune_space() == "legacy":
+            return None
+        from ..algorithms import SWITCHABLE_ALGORITHMS
+
+        current = getattr(self.algorithm, "name", None) or ""
+        families: list = []
+        flat_families: list = []
+        if current in SWITCHABLE_ALGORITHMS:
+            for name, ctor in SWITCHABLE_ALGORITHMS.items():
+                proto = self._user_algorithms.get(name) or ctor(False)
+                if name != current:
+                    # static mirror of _maybe_switch_algorithm's refusals:
+                    # a family the trainer would refuse must not be in the
+                    # space (its windows would score the refusal, not the
+                    # config)
+                    if (
+                        self.algorithm.owns_optimizer
+                        and not proto.owns_optimizer
+                        and self.optimizer is None
+                    ):
+                        continue
+                    if proto.replicated_params != self.algorithm.replicated_params:
+                        if self.algorithm.owns_optimizer or proto.owns_optimizer:
+                            continue
+                        if (
+                            self.expert_axis is not None
+                            or self._shard_axis is not None
+                        ):
+                            continue
+                families.append(name)
+                if proto.supports_flat_resident:
+                    flat_families.append(name)
+        flat_ok = (
+            self._flat_supported()
+            and self.algorithm.replicated_params
+            and not self.algorithm.owns_optimizer
+            and not self.algorithm.sharded_opt_state
+            and self.optimizer is not None
+            and getattr(self.optimizer, "fused_inner", None) is None
+            and _optimizer_flattens_safely(self.optimizer)
+        )
+        return {
+            "space": "v2",
+            "two_tier": self._inter is not None and self._intra is not None,
+            "ef_ok": bool(self._ef_enabled),
+            "flat_ok": bool(flat_ok),
+            "families": families,
+            "flat_families": flat_families,
+            "current_algorithm": current,
+        }
 
     def _apply_recommendation(self, recommended) -> None:
         # snapshot EF-residual activeness: any knob below (family switch,
@@ -2586,6 +2659,11 @@ class BaguaTrainer:
             if decl_buckets:
                 self.rebucket(decl_buckets)
                 self.bucket_bytes = recommended.bucket_size
+        # flat-residency rides the recommendation path AFTER any rebucket
+        # so the queued flat<->leaf conversion composes against the plan
+        # the step will actually run (migrations apply in queue order)
+        if getattr(recommended, "flat_resident", ""):
+            self._apply_flat_resident(recommended.flat_resident)
         # hierarchical toggle is only meaningful when the mesh has both
         # tiers, and only for families whose staged path is layout-free.
         # ZeRO is excluded: its staged mode changes the OPT-STATE SHARDING
@@ -2600,6 +2678,126 @@ class BaguaTrainer:
         ):
             self.algorithm.hierarchical = bool(recommended.is_hierarchical_reduce)
         self._sync_ef_state(ef_was)
+
+    def _apply_flat_resident(self, want: str) -> None:
+        """Apply a ``flat_resident`` recommendation ("on"/"off"; v2 knob).
+
+        Before ``init()`` resolves the layout, this only adjusts the MODE —
+        the state is then built directly in the recommended layout, no
+        conversion needed.  After, it queues a live flat<->leaf state
+        migration (the same structural conversion ``restore_checkpoint``
+        uses for cross-layout restores): every param-shaped subtree of the
+        TrainState — params and the optimizer moments that mirror them —
+        swaps between the leaf pytree and the ``{"flats", "local"}`` bucket
+        container, under the CURRENT plan, so no training math changes.
+        The flip re-jits through ``_step_key`` (``self._flat_resident`` is
+        keyed) and the migration window lands in the goodput ledger's
+        ``state_migration`` class, so the search pays for its own curiosity
+        honestly.
+
+        Refusal cases (logged, never raised — a recommendation must not
+        take down training): families owning their optimizer or sharding
+        opt state (their state is not param-mirrored), unsupported meshes,
+        optimizers that don't commute with flattening, and fused-wrapper
+        optimizers (the wrapper's leaf state and its inner's flat state
+        are not positionally convertible — a live flip would re-init
+        momentum)."""
+        if want not in ("on", "off"):
+            return
+        if not self._flat_layout_live:
+            # registration-time recommendation: init() is about to build
+            # the state — steer _resolve_flat_resident instead of migrating
+            if want == "off" or (
+                self._flat_supported()
+                and not self.algorithm.owns_optimizer
+                and not self.algorithm.sharded_opt_state
+                and _optimizer_flattens_safely(self._flat_opt())
+            ):
+                self.flat_resident = want
+            else:
+                logger.info(
+                    "autotune: flat_resident=%s not supported by this "
+                    "configuration; keeping mode %r", want, self.flat_resident,
+                )
+            return
+        want_on = want == "on"
+        if want_on == self._flat_resident:
+            return
+        algo = self.algorithm
+        if (
+            algo.owns_optimizer
+            or algo.sharded_opt_state
+            or not algo.replicated_params
+        ):
+            logger.info(
+                "autotune: live flat_resident=%s ignored — %s state is not "
+                "param-mirrored replicated", want, type(algo).__name__,
+            )
+            return
+        if getattr(self.optimizer, "fused_inner", None) is not None:
+            logger.info(
+                "autotune: live flat_resident flip ignored — fused-wrapper "
+                "optimizer state is not convertible in place",
+            )
+            return
+        if want_on and not (
+            self._flat_supported()
+            and self.optimizer is not None
+            and _optimizer_flattens_safely(self.optimizer)
+        ):
+            logger.info(
+                "autotune: flat_resident=on refused — layout unsupported "
+                "or optimizer does not commute with flattening",
+            )
+            return
+        if self._param_template is None or self._plan is None:
+            return
+        param_def = jax.tree_util.tree_structure(self._param_template)
+        if param_def == jax.tree_util.tree_structure(0):
+            logger.info(
+                "autotune: flat_resident flip needs a structured param "
+                "tree (bare-leaf params cannot be located structurally)",
+            )
+            return
+        plan, template = self._plan, self._param_template
+        is_zp = self._is_flat_container
+
+        def is_param_tree(x):
+            try:
+                return jax.tree_util.tree_structure(x) == param_def
+            except Exception:  # unhashable/exotic leaves
+                return False
+
+        if want_on:
+
+            def convert(state):
+                logger.info("autotune: relaying state leaf -> bucket-flat")
+
+                def to_flat(x):
+                    if is_param_tree(x):
+                        return {"flats": tuple(plan.flatten_tree(x)),
+                                "local": {}}
+                    return x
+
+                return jax.tree.map(to_flat, state, is_leaf=is_param_tree)
+        else:
+            from ..tensor import tree_from_named
+
+            def convert(state):
+                logger.info("autotune: relaying state bucket-flat -> leaf")
+
+                def from_flat(x):
+                    if is_zp(x):
+                        named = plan.unflatten_to_named(list(x["flats"]))
+                        named.update(x["local"])
+                        return tree_from_named(template, named)
+                    return x
+
+                return jax.tree.map(from_flat, state, is_leaf=is_zp)
+
+        self._queue_state_migration(convert)
+        self._flat_resident = want_on
+        logger.info("autotune: flat_resident -> %s (migration queued)", want)
 
     def _maybe_switch_algorithm(self, recommended) -> None:
         """Swap the algorithm family if the autotuner asked for one
@@ -2851,6 +3049,7 @@ class BaguaTrainer:
                 hyperparameters=self._current_hyperparameters().model_dump(),
                 speed=speed,
                 perf_hints=hints or None,
+                obs=self._autotune_obs_window(),
             )
             hints_delivered = True
             rsp = client.ask_hyperparameters(
@@ -2874,6 +3073,68 @@ class BaguaTrainer:
                 logger.warning("autotune disabled after repeated failures")
                 self.autotune = False
 
+    def _autotune_obs_window(self) -> Optional[dict]:
+        """The rank's windowed efficiency observations for the check-in
+        (the v2 scoring input): goodput fraction of the window since the
+        last report — delta of the CUMULATIVE ledger classes, so compile
+        and migration badput the current config caused lands in its own
+        score — plus MFU, the DCN share of the step, HBM headroom, and the
+        rank-local anomaly flag from the obs summary.  ``None`` when the
+        obs plane is off (``BAGUA_OBS=off``), goodput reporting is
+        disabled (``BAGUA_AUTOTUNE_GOODPUT=off``), or no window has
+        elapsed yet — the service then scores on summed speed as before.
+        """
+        if self._ledger is None or not env.get_autotune_goodput():
+            return None
+        try:
+            rep = self._ledger.report()
+        except Exception:  # the score input must never take down training
+            return None
+        if not rep:
+            return None
+        classes = dict(rep.get("classes") or {})
+        snap = {"wall_s": float(rep.get("wall_s") or 0.0), "classes": classes}
+        prev, self._autotune_ledger_prev = self._autotune_ledger_prev, snap
+        if prev is None:
+            # first check-in: the window opens at the ledger's first noted
+            # second, so the initial config's own compile lands in its own
+            # score — and EVERY window is goodput-scored from window one
+            # (one speed-scaled sample would dominate best() forever)
+            prev = {"wall_s": 0.0, "classes": {}}
+        dwall = snap["wall_s"] - prev["wall_s"]
+        if dwall <= 0:
+            return None
+        from ..obs.ledger import GOODPUT_CLASSES
+
+        dgood = sum(
+            classes.get(c, 0.0) - prev["classes"].get(c, 0.0)
+            for c in GOODPUT_CLASSES
+        )
+        obs = {
+            "goodput_fraction": max(0.0, min(1.0, dgood / dwall)),
+            "window_wall_s": round(dwall, 3),
+        }
+        try:
+            from ..obs import export as _obs_export
+
+            summary = _obs_export.local_obs_summary() or {}
+        except Exception:
+            summary = {}
+        if summary.get("mfu") is not None:
+            obs["mfu"] = summary["mfu"]
+        dcn = summary.get("device_comm_dcn_s_per_step")
+        if dcn is not None:
+            obs["dcn_s_per_step"] = dcn
+            dt = summary.get("step_dt_p50")
+            if dt:
+                obs["dcn_share"] = max(0.0, min(1.0, float(dcn) / float(dt)))
+        if summary.get("hbm_headroom_bytes") is not None:
+            obs["hbm_headroom_bytes"] = summary["hbm_headroom_bytes"]
+        if summary.get("straggler_suspect"):
+            # the service discards (re-measures) anomaly-flagged windows
+            obs["anomaly"] = True
+        return obs
+
     def _current_hyperparameters(self):
         from ..define import BaguaHyperparameter
 
@@ -2892,6 +3153,7 @@ class BaguaTrainer:
             overlap_chunk_bytes_inter=int(self.overlap_chunk_bytes_inter),
             compress_intra=self.compress_intra,
             compress_inter=self.compress_inter,
+            flat_resident="on" if self._flat_resident else "off",
         )
 
     def _batch_spec(self) -> P:

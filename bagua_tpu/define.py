@@ -80,6 +80,11 @@ class BaguaHyperparameter(BaseModel):
     #: dominate the step)
     compress_intra: str = ""
     compress_inter: str = ""
+    #: bucket-flat residency of the training state ("on"|"off"; "" = keep
+    #: current).  A live flip queues a flat<->leaf state migration on the
+    #: trainer (same conversion the checkpoint path uses), so the v2
+    #: search can trade the per-step flatten against relayout cost
+    flat_resident: str = ""
 
     def update(self, param_dict: dict) -> "BaguaHyperparameter":
         tmp = self.model_dump()

@@ -178,6 +178,17 @@ _declare("BAGUA_AUTOTUNE_WARMUP_TIME_S", "float", "30.0",
 _declare("BAGUA_AUTOTUNE_ALGORITHM", "bool", "0",
          "Let the autotuner search over algorithm families too "
          "(centralized / low-precision selectable; TPU extension).")
+_declare("BAGUA_AUTOTUNE_GOODPUT", "bool", "1",
+         "Score autotune sampling windows on fleet-min goodput (windowed "
+         "goodput_fraction/MFU/DCN-share observations ride each check-in "
+         "when the obs plane is on); 0 reports no observations, falling "
+         "back to the summed-speed score.")
+_declare("BAGUA_AUTOTUNE_SPACE", "str", "auto",
+         "Autotune search space: 'auto' reports trainer capabilities at "
+         "registration so the service searches the full capability-gated "
+         "v2 knob space (overlap + per-tier chunk bytes, codec ladder, "
+         "flat residency, family switching); 'legacy' keeps the "
+         "bucket-size x hierarchical two-knob space.")
 _declare("BAGUA_REPORT_METRICS", "bool", "0",
          "Report training metrics to the autotune service.")
 _declare("BAGUA_IS_OUTPUT_AUTOTUNE_LOG", "bool", "0",
@@ -763,6 +774,18 @@ def is_autotune_algorithm_on() -> bool:
     """Let the autotuner search over algorithm families too (TPU extension;
     BASELINE.json wants centralized/low-precision selectable)."""
     return env_bool("BAGUA_AUTOTUNE_ALGORITHM")
+
+
+def get_autotune_goodput() -> bool:
+    """Whether check-ins carry windowed goodput/MFU/DCN observations (the
+    v2 fleet-min-goodput score input; needs the obs plane on to matter)."""
+    return env_bool("BAGUA_AUTOTUNE_GOODPUT")
+
+
+def get_autotune_space() -> str:
+    """'auto' (capability-gated v2 knob space) or 'legacy' (two-knob)."""
+    v = env_str("BAGUA_AUTOTUNE_SPACE").strip().lower()
+    return v if v in ("auto", "legacy") else "auto"
 
 
 def is_report_metrics_switch_on() -> bool:

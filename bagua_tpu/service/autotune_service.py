@@ -46,6 +46,17 @@ class _TaskState:
         self.recommended = BaguaHyperparameter(
             bucket_size=service.default_bucket_size
         )
+        #: trainer-reported capabilities (mesh tiers, EF/flat legality,
+        #: switchable families) — presence selects the v2 knob space
+        self.capabilities: Optional[dict] = None
+        #: per-rank efficiency observations riding the check-in (windowed
+        #: goodput_fraction / mfu / dcn share / hbm headroom; replace
+        #: semantics like ``speed_by_rank``) — the v2 scoring input
+        self.obs_by_rank: Dict[int, dict] = {}
+        #: last HBM headroom per rank, for the shrinking-headroom trend
+        #: weighting; one flat-residency prior max per search
+        self.hbm_prev: Dict[int, float] = {}
+        self.flat_primed = False
         self.first_ask_time: Optional[float] = None
         self.sample_start_time: Optional[float] = None
         self.sample_start_iter = 0
@@ -64,6 +75,13 @@ class _TaskState:
         self.perf_hints_total = 0
         self.sample_hint_mark = 0
         self.sample_retried = False
+        #: scoring mode the task's FIRST scored window established (True =
+        #: fleet-min goodput, False = summed speed).  Goodput lives in
+        #: [0, 1]; speed is steps/s-scaled — one sample scored on the
+        #: other scale would dominate (or vanish under) every honest one
+        #: in the optimizer's best(), so a window whose mode disagrees is
+        #: re-measured once and then discarded from the tell
+        self.goodput_mode: Optional[bool] = None
         # fleet-autopilot controller state (docs/autopilot.md): a pinned
         # algorithm family overrides every recommendation until cleared
         # (the ladder's switch rung must survive later BO points), and
@@ -114,6 +132,12 @@ class AutotuneService:
         with task.lock:
             if not task.tensor_list:
                 task.tensor_list = decls
+                caps = req.get("capabilities")
+                if isinstance(caps, dict):
+                    task.capabilities = caps
+                    # capability-gated v2 knob space: the trainer's mesh /
+                    # family / layout legality decides which knobs exist
+                    task.manager.configure_space(caps)
                 from ..bucket import split_bucket_by_bucket_size
 
                 task.recommended = BaguaHyperparameter(
@@ -132,16 +156,80 @@ class AutotuneService:
         with task.lock:
             if rank >= 0:
                 task.speed_by_rank[rank] = float(req["speed"])
+                obs = req.get("obs")
+                if isinstance(obs, dict):
+                    task.obs_by_rank[rank] = obs
+                    self._ingest_trends(task, rank, obs)
             # a NEGATIVE rank is a controller (the fleet autopilot, rank
             # -1): its report carries hints only — recording its zero
             # "speed" would poison the ranks' summed score
             for hint in req.get("perf_hints") or []:
                 if isinstance(hint, dict):
+                    # codec names are validated ONCE here at ingest
+                    # (invalid -> stripped with a warning); everything
+                    # downstream — the pin path, the prior builder, every
+                    # tell iteration — trusts the normalized value
+                    hint = self._normalize_hint(task, hint)
                     task.perf_hints.append({**hint, "reported_by": rank})
                     task.perf_hints_total += 1
                     self._apply_controller_hint(task, hint)
             del task.perf_hints[:-64]  # bounded: hints are context, not log
         return {"message": "ok"}
+
+    def _normalize_hint(self, task: _TaskState, hint: dict) -> dict:
+        """Validate a hint's codec name exactly once at ingest.  An
+        unknown codec is replaced by the empty string — downstream
+        consumers skip actuation/priming on it but still honor the hint's
+        other semantics (re-measure re-grant)."""
+        codec = hint.get("codec")
+        if codec is None:
+            return hint
+        from ..compression.codecs import validate_codec_policy
+
+        try:
+            return {**hint, "codec": validate_codec_policy(
+                str(codec), "compress_inter")}
+        except ValueError as e:
+            logger.warning(
+                "autotune[%s]: hint %r carried an unknown codec, "
+                "stripped at ingest: %s",
+                task.model_name, hint.get("kind"), e,
+            )
+            return {**hint, "codec": ""}
+
+    def _ingest_trends(self, task: _TaskState, rank: int, obs: dict) -> None:
+        """Historian-style trend signals riding the check-in become
+        COORDINATE WEIGHTS and (once) a warm-start prior for a live v2
+        search — never recommendation pins (caller holds ``task.lock``).
+
+        * sustained DCN share of the step -> bias the exploit step toward
+          the DCN-tier knobs (``compress_inter``, the inter chunk size);
+        * shrinking HBM headroom -> bias toward ``flat_resident`` and
+          prime one flat-layout point (the resident layout drops the
+          per-step flatten temporaries).
+        """
+        mgr = task.manager
+        if mgr.space is None or task.completed:
+            return
+        dcn_share = obs.get("dcn_share")
+        if isinstance(dcn_share, (int, float)) and dcn_share > 0.15:
+            boost = 1.0 + 4.0 * min(1.0, float(dcn_share))
+            if mgr.space.has("compress_inter"):
+                mgr.weight_coordinate("compress_inter", boost)
+            if mgr.space.has("overlap_chunk_bytes_inter_2p"):
+                mgr.weight_coordinate(
+                    "overlap_chunk_bytes_inter_2p", 1.0 + 2.0 * boost / 5.0
+                )
+        hbm = obs.get("hbm_headroom_bytes")
+        if isinstance(hbm, (int, float)):
+            prev = task.hbm_prev.get(rank)
+            task.hbm_prev[rank] = float(hbm)
+            if (prev is not None and float(hbm) < prev * 0.95
+                    and mgr.space.has("flat_resident")):
+                mgr.weight_coordinate("flat_resident", 4.0)
+                if not task.flat_primed:
+                    task.flat_primed = True
+                    mgr.prime({"flat_resident": "on"})
 
     def _apply_controller_hint(self, task: _TaskState, hint: dict) -> None:
         """Fleet-autopilot command hints (caller holds ``task.lock``).
@@ -172,21 +260,41 @@ class AutotuneService:
           start carrying compressed DCN bytes without one.
         """
         kind = hint.get("kind")
+        # a LIVE v2 search treats autopilot commands as priors, not pins:
+        # the hint decides where the optimizer looks next (a primed point
+        # plus coordinate weighting), the measured goodput decides whether
+        # it sticks.  Legacy tasks and completed searches keep the direct
+        # actuation — there is no live loop to absorb a prior.
+        v2_live = task.manager.space is not None and not task.completed
         if kind == "autopilot_compress_dcn":
-            from ..compression.codecs import validate_codec_policy
-
             task.sample_retried = False
-            codec = str(hint.get("codec") or "minmax_uint8")
-            try:
-                task.recommended.compress_inter = validate_codec_policy(
-                    codec, "compress_inter"
+            # codec was validated at ingest ("" = stripped as unknown)
+            codec = str(hint.get("codec", "minmax_uint8"))
+            if not codec:
+                logger.warning(
+                    "autotune[%s]: compress_dcn hint had no valid codec, "
+                    "NOT actuated (re-measure still re-granted)",
+                    task.model_name,
                 )
-            except ValueError as e:
-                logger.warning("autotune[%s]: compress_dcn hint carried an "
-                               "unknown codec, NOT actuated (re-measure "
-                               "still re-granted): %s",
-                               task.model_name, e)
+            elif v2_live and task.manager.space.has("compress_inter"):
+                task.manager.prime({
+                    "compress_inter": codec,
+                    "is_hierarchical_reduce": True,
+                })
+                share = hint.get("dcn_share")
+                boost = (
+                    2.0 + 6.0 * min(1.0, float(share))
+                    if isinstance(share, (int, float)) else 4.0
+                )
+                task.manager.weight_coordinate("compress_inter", boost)
+                logger.info(
+                    "autotune[%s]: autopilot reports sustained DCN "
+                    "dominance; primed DCN codec %r as a search prior "
+                    "(goodput keeps the last word; re-measure re-granted)",
+                    task.model_name, codec,
+                )
             else:
+                task.recommended.compress_inter = codec
                 logger.info(
                     "autotune[%s]: autopilot reports sustained DCN "
                     "dominance; actuating DCN codec %r (suggested "
@@ -205,7 +313,15 @@ class AutotuneService:
                 )
         elif kind == "autopilot_switch_family":
             family = hint.get("family")
-            if family:
+            if family and v2_live and task.manager.space.has("algorithm"):
+                task.manager.prime({"algorithm": str(family)})
+                task.manager.weight_coordinate("algorithm", 4.0)
+                logger.info(
+                    "autotune[%s]: autopilot suggested family %r; primed "
+                    "as a search prior (goodput keeps the last word)",
+                    task.model_name, family,
+                )
+            elif family:
                 task.pinned_algorithm = str(family)
                 task.recommended.algorithm = str(family)
                 logger.info(
@@ -271,7 +387,13 @@ class AutotuneService:
         )
         if not (all_ranks_in and long_enough):
             return self._reply(task)
-        if task.perf_hints_total > task.sample_hint_mark \
+        # an anomaly-flagged window (rank-local detector flag riding the
+        # obs payload) is discarded like a hint-tainted one: re-measure
+        # once before scoring, then score honestly
+        anomaly_flagged = any(
+            bool(o.get("anomaly")) for o in task.obs_by_rank.values()
+        )
+        if (task.perf_hints_total > task.sample_hint_mark or anomaly_flagged) \
                 and not task.sample_retried:
             # the window carried anomaly hints (a straggler, an injected
             # stall): its speed measures the environment, not the point —
@@ -289,10 +411,36 @@ class AutotuneService:
             task.sample_start_time = now
             task.sample_start_iter = train_iter
             return self._reply(task)
-        score = sum(task.speed_by_rank.values())
-        task.manager.record_sample(train_iter, task.recommended, score)
+        score, scored_on_goodput = self._score(task)
+        if task.goodput_mode is None:
+            task.goodput_mode = scored_on_goodput
+        usable = scored_on_goodput == task.goodput_mode
+        if not usable and not task.sample_retried:
+            # scale guard: the window's scoring mode disagrees with the
+            # task's established one (obs coverage appeared or vanished
+            # mid-search) — re-measure once before giving up on it
+            logger.info(
+                "autotune[%s]: window scored on %s but the search runs on "
+                "%s — re-measuring before scoring",
+                task.model_name,
+                "goodput" if scored_on_goodput else "speed",
+                "goodput" if task.goodput_mode else "speed",
+            )
+            task.sample_retried = True
+            task.sample_start_time = now
+            task.sample_start_iter = train_iter
+            return self._reply(task)
+        if usable:
+            task.manager.record_sample(train_iter, task.recommended, score)
+        else:
+            logger.warning(
+                "autotune[%s]: window still scored on the wrong scale "
+                "after a re-measure — sample spent, observation discarded",
+                task.model_name,
+            )
         next_hp = task.manager.ask_hyperparameters(
-            train_iter, task.tensor_list, task.recommended, score
+            train_iter, task.tensor_list, task.recommended,
+            score if usable else None,
         )
         task.n_samples += 1
         if task.n_samples >= self.max_samples + task.extra_samples:
@@ -301,8 +449,10 @@ class AutotuneService:
             task.completed = True
             task.manager.close()
             logger.info(
-                "autotune[%s] completed after %d samples: bucket=%d hier=%s algo=%s",
+                "autotune[%s] completed after %d samples (scored on %s): "
+                "bucket=%d hier=%s algo=%s",
                 task.model_name, task.n_samples,
+                "fleet-min goodput" if scored_on_goodput else "summed speed",
                 task.recommended.bucket_size,
                 task.recommended.is_hierarchical_reduce,
                 task.recommended.algorithm or "-",
@@ -314,6 +464,33 @@ class AutotuneService:
         task.sample_hint_mark = task.perf_hints_total
         task.sample_retried = False
         return self._reply(task)
+
+    def _score(self, task: _TaskState) -> "tuple[float, bool]":
+        """The sampling window's score (caller holds ``task.lock``).
+
+        With every reporting rank carrying a goodput observation, the
+        score is FLEET-MIN GOODPUT — the fleet is only as productive as
+        its least productive rank (a config that compiles fast on seven
+        ranks and churns on the eighth is a bad config) — with summed
+        speed as a bounded tiebreak (< 1e-4, so it can never outvote a
+        real goodput difference).  Compile churn is charged naturally:
+        every re-jit the config causes lands in its own window's badput.
+        Without full goodput coverage (obs plane off, old trainers) the
+        legacy summed-speed score stands.
+        """
+        speed_sum = sum(task.speed_by_rank.values())
+        goodputs = [
+            o.get("goodput_fraction") for o in task.obs_by_rank.values()
+        ]
+        if (
+            goodputs
+            and len(task.obs_by_rank) >= len(task.speed_by_rank)
+            and len(task.speed_by_rank) >= self.world_size
+            and all(isinstance(g, (int, float)) for g in goodputs)
+        ):
+            tiebreak = 1e-4 * speed_sum / (1.0 + speed_sum)
+            return min(float(g) for g in goodputs) + tiebreak, True
+        return speed_sum, False
 
     def _reply(self, task: _TaskState) -> dict:
         if task.pinned_algorithm:
@@ -443,16 +620,22 @@ class AutotuneClient:
             time.sleep(0.1)
         raise TimeoutError("autotune service did not come up")
 
-    def register_tensors(self, model_name: str, tensor_list: List[dict]) -> dict:
-        return self._post(
-            "register_tensors",
-            {"model_name": model_name, "tensor_list": tensor_list},
-        )
+    def register_tensors(
+        self, model_name: str, tensor_list: List[dict],
+        capabilities: Optional[dict] = None,
+    ) -> dict:
+        payload = {"model_name": model_name, "tensor_list": tensor_list}
+        if capabilities:
+            # v2: what the trainer's mesh/family/layout makes legal —
+            # selects the capability-gated knob space service-side
+            payload["capabilities"] = capabilities
+        return self._post("register_tensors", payload)
 
     def report_metrics(
         self, model_name: str, rank: int, train_iter: int,
         hyperparameters: dict, speed: float,
         perf_hints: Optional[List[dict]] = None,
+        obs: Optional[dict] = None,
     ) -> dict:
         payload = {
             "model_name": model_name, "rank": rank,
@@ -463,6 +646,10 @@ class AutotuneClient:
             # anomaly-detector hints (bagua_tpu.obs.anomaly): the sampling
             # state machine re-measures a window these taint
             payload["perf_hints"] = perf_hints
+        if obs:
+            # windowed efficiency observations (goodput_fraction, mfu,
+            # dcn share, hbm headroom): the v2 scoring + trend input
+            payload["obs"] = obs
         return self._post("report_metrics", payload)
 
     def ask_hyperparameters(self, model_name: str, rank: int, train_iter: int) -> dict:

@@ -10,6 +10,14 @@ The search dimension gains one TPU-specific axis over the reference: the
 algorithm *family* is part of the tunable space when ``tune_algorithm`` is on
 (BASELINE.json requires the centralized / decentralized / low-precision
 families to be selectable by the autotuner).
+
+Autotune v2 (ISSUE 19): when the trainer reports capabilities at tensor
+registration, :meth:`AutotuneTaskManager.configure_space` swaps the legacy
+two-knob space for the full capability-gated knob space
+(:mod:`.knob_space`) — overlap + per-tier chunk bytes, the codec ladder,
+flat residency, and family switching — with conditional sampling so
+inactive knobs never burn samples.  Tasks without capabilities keep the
+legacy space and materialization byte-for-byte.
 """
 
 from __future__ import annotations
@@ -23,6 +31,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from ..bucket import split_bucket_by_bucket_size
 from ..define import BaguaHyperparameter, TensorDeclaration
 from .bayesian_optimizer import BayesianOptimizer, BoolParam, IntParam
+from .knob_space import KnobSpace, build_knob_space
 
 logger = logging.getLogger(__name__)
 
@@ -55,6 +64,9 @@ class AutotuneTaskManager:
             params.append(IntParam("algorithm_index", 0, len(ALGORITHM_FAMILIES) - 1))
         self.tune_algorithm = tune_algorithm
         self.optimizer = BayesianOptimizer(params)
+        #: v2 knob space (None = legacy two-knob space); set once via
+        #: :meth:`configure_space` from the task's registration capabilities
+        self.space: Optional[KnobSpace] = None
         # sample history: (train_iter, hyperparameters, score)
         self.records: Deque[Tuple[int, BaguaHyperparameter, float]] = deque(maxlen=100)
         self.tensor_partial_order: Dict[str, int] = {}
@@ -68,6 +80,34 @@ class AutotuneTaskManager:
             )
             self._log_file = f
             logger.info("autotune log -> %s", path)
+
+    def configure_space(self, capabilities: Optional[Dict]) -> None:
+        """Swap in the capability-gated v2 knob space (idempotent; no-op
+        for legacy/absent capabilities or once sampling has begun — a
+        mid-search space change would orphan every observation)."""
+        if self.space is not None or self.records:
+            return
+        space = build_knob_space(capabilities, self.tune_algorithm)
+        if space is None:
+            return
+        self.space = space
+        self.optimizer = BayesianOptimizer(
+            space.params, conditions=space.conditions
+        )
+        logger.info(
+            "autotune[%s]: v2 knob space active (%s)",
+            self.task_name, ", ".join(space.names()),
+        )
+
+    def prime(self, updates: Dict) -> None:
+        """Warm-start prior from an autopilot hint / historian trend:
+        queue a point near the current best with ``updates`` applied
+        (hyperparameter-field names == v2 param names)."""
+        self.optimizer.prime(updates)
+
+    def weight_coordinate(self, name: str, w: float) -> None:
+        """Bias the exploit step toward one coordinate (trend weighting)."""
+        self.optimizer.weight(name, w)
 
     def record_sample(
         self, train_iter: int, hp: BaguaHyperparameter, score: float
@@ -105,6 +145,12 @@ class AutotuneTaskManager:
         last_score: Optional[float],
     ) -> BaguaHyperparameter:
         """tell the last sample's score, ask the next point, materialize it."""
+        if self.space is not None:
+            if last_score is not None:
+                self.optimizer.tell(
+                    self.space.point_from_hp(last_hp), last_score
+                )
+            return self._materialize(self.optimizer.ask(), tensor_list, last_hp)
         if last_score is not None:
             point = {
                 "bucket_size_2p": max(last_hp.bucket_size, 1).bit_length() - 1,
@@ -126,6 +172,25 @@ class AutotuneTaskManager:
     ) -> BaguaHyperparameter:
         bucket_size = 2 ** point["bucket_size_2p"]
         ordered = self._order_tensors(tensor_list)
+        if self.space is not None:
+            # v2: searched knobs come from the point (inactive ones emit
+            # their keep-current sentinel), unsearched knobs carry through
+            hp = BaguaHyperparameter(
+                buckets=split_bucket_by_bucket_size(ordered, bucket_size),
+                bucket_size=bucket_size,
+                overlap_chunk_bytes=(
+                    last_hp.overlap_chunk_bytes if last_hp is not None else 0
+                ),
+            )
+            if last_hp is not None:
+                for fld in ("is_hierarchical_reduce", "overlap",
+                            "overlap_chunk_bytes_intra",
+                            "overlap_chunk_bytes_inter",
+                            "compress_intra", "compress_inter",
+                            "flat_resident"):
+                    setattr(hp, fld, getattr(last_hp, fld))
+            hp.update(self.space.point_to_updates(point))
+            return hp
         return BaguaHyperparameter(
             buckets=split_bucket_by_bucket_size(ordered, bucket_size),
             bucket_size=bucket_size,

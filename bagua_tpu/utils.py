@@ -127,7 +127,13 @@ class StatisticalAverage:
         self.record_tail = record_tail
 
     def record_seconds(self) -> float:
-        return 2.0 ** len(self.records) if self.records else 0.0
+        # buckets of 1, 2, 4, ... 2^(L-1) seconds cover 2^L - 1 seconds.
+        # Claiming 2^L here would self-inflate: record()'s regrow loop runs
+        # while 2^i <= total + elapsed, so an overcount of exactly one
+        # second makes EVERY call grow the list by one bucket regardless of
+        # elapsed time — unbounded, and 2.0 ** i overflows after ~1000
+        # steps of training
+        return 2.0 ** len(self.records) - 1.0 if self.records else 0.0
 
     def total_recording_time(self) -> float:
         tail_sec, _ = self.record_tail
@@ -158,6 +164,8 @@ class StatisticalAverage:
         return mean
 
     def record(self, val: float):
+        if not math.isfinite(val):
+            return  # a zero-dt window's inf rate would poison every mean
         now = time.time()
         elapsed = now - self.last_update_time
         new_records: List[float] = []

@@ -15,13 +15,111 @@ script is the on-chip evidence that the search runs on a real score
 surface and that the recompile cost amortizes.  Results:
 AUTOTUNE_TPU_SMOKE.json.
 
-Usage: python benchmarks/autotune_smoke.py
+``--ci`` runs the GOODPUT-SCORED smoke instead: one v2 search round on
+the 8-device cpu-sim two-tier mesh, asserting the sidecar received the
+trainer's windowed obs payloads (goodput_fraction aboard), built the
+capability-gated v2 knob space, and scored the windows on fleet-min
+goodput rather than summed speed.  Exit code carries the verdict (the
+ci.sh autotune stage).
+
+Usage: python benchmarks/autotune_smoke.py        # on-chip, to completion
+       python benchmarks/autotune_smoke.py --ci   # cpu goodput-scored round
 """
 
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--ci" in sys.argv:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=8")
+    os.environ.pop("BAGUA_SERVICE_PORT", None)
+    os.environ["BAGUA_OBS"] = "on"
+    os.environ["BAGUA_AUTOTUNE_GOODPUT"] = "1"
+    import json
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bagua_tpu.algorithms.gradient_allreduce import (
+        GradientAllReduceAlgorithm,
+    )
+    from bagua_tpu.core.backend import BaguaTrainer
+    from bagua_tpu.models.mlp import MLP
+    from bagua_tpu.parallel.mesh import build_mesh
+    from bagua_tpu.service.autotune_service import AutotuneService, make_server
+
+    service = AutotuneService(world_size=1, autotune_level=1, max_samples=2,
+                              sampling_confidence_time_s=0.0,
+                              warmup_time_s=0.0, default_bucket_size=1 << 14)
+    server = make_server(0, service)
+    os.environ["BAGUA_SERVICE_PORT"] = str(server.server_address[1])
+    os.environ["MASTER_ADDR"] = "127.0.0.1"
+    os.environ["BAGUA_AUTOTUNE"] = "1"
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    from bagua_tpu import communication
+
+    communication.get_hyperparameters_service_client.cache_clear()
+
+    # two-tier mesh -> the FULL v2 space (hierarchical reduce, DCN-tier
+    # codec + chunk knobs) is legal and capability-selected
+    mesh = build_mesh({"inter": 4, "intra": 2})
+    model = MLP(features=(64, 32, 8))
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    y = jnp.argmax(x @ jax.random.normal(jax.random.PRNGKey(1), (8, 8)), -1)
+    params = model.init(jax.random.PRNGKey(2), x[:2])["params"]
+
+    def ci_loss(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]).mean()
+
+    trainer = BaguaTrainer(ci_loss, optax.sgd(0.1),
+                           GradientAllReduceAlgorithm(), mesh=mesh,
+                           model_name="autotune_ci", bucket_bytes=1 << 14)
+    assert trainer.autotune, "sidecar did not come up"
+    state = trainer.init(params)
+    batch = trainer.shard_batch({"x": x, "y": y})
+    task = service._task("autotune_ci")
+    assert task.manager.space is not None, (
+        "trainer capabilities did not select the v2 knob space"
+    )
+    for knob in ("is_hierarchical_reduce", "overlap", "compress_inter"):
+        assert task.manager.space.has(knob), knob
+
+    # enough check-ins for >=1 scored sample even if windows re-measure
+    for i in range(801):
+        state, loss = trainer.train_step(state, batch)
+        if i % 10 == 1:
+            float(loss)
+        if task.n_samples >= 1 and task.obs_by_rank:
+            break
+    float(loss)
+
+    obs = task.obs_by_rank.get(0)
+    assert obs is not None, "no windowed obs payload reached the service"
+    assert isinstance(obs.get("goodput_fraction"), float), obs
+    assert task.n_samples >= 1, "no window was scored"
+    assert task.goodput_mode is True, (
+        "the search round scored on summed speed, not goodput"
+    )
+    scores = [s for _, _, s in task.manager.records]
+    assert scores and all(0.0 <= s <= 1.0 + 1e-3 for s in scores), scores
+    print(json.dumps({
+        "ci": "ok",
+        "v2_space": task.manager.space.names(),
+        "scored_windows": task.n_samples,
+        "goodput_scored": True,
+        "last_obs_goodput_fraction": obs["goodput_fraction"],
+        "scores": [round(s, 6) for s in scores],
+        "steps_run": i + 1,
+    }, indent=1), flush=True)
+    server.shutdown()
+    sys.exit(0)
 import json
 import threading
 import time

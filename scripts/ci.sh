@@ -112,6 +112,17 @@ python -m bagua_tpu.autopilot \
   --historian --trend-window-s 600 \
   --sustain 2 --cooldown-s 300 --budget 8 > /dev/null
 
+echo "=== autotune v2 smoke (goodput-scored search round, cpu mesh) ==="
+# One live v2 search round: a real trainer on the two-tier cpu-sim mesh
+# checks in with windowed goodput observations, the sidecar builds the
+# capability-gated knob space from the registration capabilities, and the
+# scored window MUST be fleet-min-goodput-scored (not summed speed).  The
+# committed convergence evidence (tuned >= default within the 24-window
+# cap) is BENCH_AUTOTUNE.json, schema-gated in tests/test_bench_sanity.py;
+# regenerate with `python benchmarks/autotune_bench.py`.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python benchmarks/autotune_smoke.py --ci > /dev/null
+
 echo "=== serve smoke (continuous-batching engine, short synthetic trace) ==="
 # The serving plane end-to-end on the 8-dev cpu-sim image: weights loaded
 # through the integrity-verified serving loader, a short Poisson trace

@@ -904,6 +904,35 @@ def test_trace_flags_autotune_mutable_attr_not_keyed():
     assert trace_rules(_fx(fx__trainer=keyed)) == []
 
 
+def test_trace_flags_transitive_autotune_knob_not_keyed():
+    """Autotune-v2 shape (the ``_flat_resident`` knob): the mutation sits
+    in a HELPER the recommendation path calls, not in
+    ``_apply_recommendation`` itself — the prover must chase the
+    transitive call closure, flag the unkeyed knob, and accept it once
+    it rides the step key."""
+    src = """
+        class Trainer:
+            def __init__(self):
+                self._flat_resident = False
+
+            def _apply_flat_resident(self, want):
+                self._flat_resident = want == "on"
+
+            def _apply_recommendation(self, rec):
+                if rec.flat_resident:
+                    self._apply_flat_resident(rec.flat_resident)
+
+            def _step_key(self):
+                return (1,)
+
+            def _make_step_fn(self):
+                return self._flat_resident
+    """
+    assert "trace-knob-not-keyed" in trace_rules(_fx(fx__trainer=src))
+    keyed = src.replace("return (1,)", "return (1, self._flat_resident)")
+    assert trace_rules(_fx(fx__trainer=keyed)) == []
+
+
 def test_constructor_frozen_attr_is_exempt():
     """An attr set only in __init__ and read by construction needs no key
     entry: the per-instance step cache cannot go stale on it."""

@@ -237,6 +237,11 @@ def test_trainer_flags_injected_straggle(clean_obs, monkeypatch):
                                base_ms=20.0, factor=10.0)):
         for _ in range(4):
             s, _ = t.train_step(s, b)
+    # drain the async dispatch queue before the observe step: its cadence
+    # sample must measure the step, not 12 queued steps' device backlog
+    import jax
+
+    jax.block_until_ready(s.params)
     s, _ = t.train_step(s, b)  # observe the last straggled window
     flagged = [sp for sp in t.anomaly_detector.suspects
                if sp["step"] >= start]

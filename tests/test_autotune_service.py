@@ -197,6 +197,249 @@ def test_trainer_switches_algorithm():
     assert losses[-1] < float(l0)
 
 
+# -- autotune v2: goodput scoring, conditional space, priors-not-pins -----
+
+
+V2_CAPS = {
+    "space": "v2",
+    "two_tier": True,
+    "ef_ok": True,
+    "flat_ok": False,
+    "families": ["gradient_allreduce"],
+    "flat_families": [],
+    "current_algorithm": "gradient_allreduce",
+}
+
+
+def _v2_service_client(**kw):
+    service = AutotuneService(
+        world_size=kw.pop("world_size", 1),
+        autotune_level=1,
+        max_samples=kw.pop("max_samples", 20),
+        sampling_confidence_time_s=0.0,
+        warmup_time_s=0.0,
+        **kw,
+    )
+    server = make_server(0, service)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    client = AutotuneClient("127.0.0.1", port)
+    client.wait_until_ready(10)
+    return service, client, server
+
+
+def test_tell_folds_repeated_observations_into_running_mean():
+    opt = BayesianOptimizer([IntParam("x", 0, 10)])
+    opt.tell({"x": 4}, 1.0)
+    opt.tell({"x": 4}, 3.0)
+    opt.tell({"x": 4}, 5.0)
+    best, mean = opt.best()
+    assert best["x"] == 4
+    assert mean == pytest.approx(3.0)
+    # a single lucky window of a worse config cannot outvote the mean
+    opt.tell({"x": 9}, 4.0)
+    opt.tell({"x": 9}, 0.0)
+    best, mean = opt.best()
+    assert best["x"] == 4 and mean == pytest.approx(3.0)
+
+
+def test_conditional_space_never_varies_inactive_knobs():
+    """Sampled points never differ only on a dead knob: while overlap is
+    off the chunk coordinates sit at canonical lows, while hierarchical
+    reduce is off BOTH tier codecs sit at their canonical first choice
+    (the flat comm world spans both mesh axes — no per-tier rings)."""
+    from bagua_tpu.service.knob_space import (
+        MIN_CHUNK_BYTES_EXP,
+        build_knob_space,
+    )
+
+    space = build_knob_space(V2_CAPS, tune_algorithm=False)
+    assert space is not None
+    opt = BayesianOptimizer(space.params, conditions=space.conditions)
+    seen = set()
+    for _ in range(300):
+        p = opt.ask()
+        act = space.active(p)
+        if p["overlap"] == "off":
+            assert not act["overlap_chunk_bytes_intra_2p"]
+            assert p["overlap_chunk_bytes_intra_2p"] == MIN_CHUNK_BYTES_EXP
+            assert p["overlap_chunk_bytes_inter_2p"] == MIN_CHUNK_BYTES_EXP
+        if not p["is_hierarchical_reduce"]:
+            assert not act["compress_intra"] and not act["compress_inter"]
+            assert p["compress_intra"] == "auto"
+            assert p["compress_inter"] == "auto"
+            # ...and the rendered recommendation keeps the live values
+            # ("" / 0 = keep-current sentinels), never a forced codec
+            upd = space.point_to_updates(p)
+            assert upd["compress_intra"] == "" and upd["compress_inter"] == ""
+        if p["overlap"] == "off":
+            assert space.point_to_updates(p)["overlap_chunk_bytes_intra"] == 0
+        seen.add(tuple(p[k] for k in space.names()))
+        opt.tell(p, 1.0)
+    # the space is genuinely explored (not collapsed by canonicalization)
+    assert len(seen) > 20
+
+
+def test_ef_codec_rungs_gated_on_capability():
+    from bagua_tpu.service.knob_space import build_knob_space
+
+    gated = build_knob_space({**V2_CAPS, "ef_ok": False}, False)
+    open_ = build_knob_space(V2_CAPS, False)
+    gated_choices = next(
+        p for p in gated.params if p.name == "compress_inter").choices
+    open_choices = next(
+        p for p in open_.params if p.name == "compress_inter").choices
+    assert "onebit_ef" not in gated_choices and "topk" not in gated_choices
+    assert "onebit_ef" in open_choices and "topk" in open_choices
+    # single-tier mesh: no DCN knobs at all, intra codec unconditional
+    single = build_knob_space({**V2_CAPS, "two_tier": False}, False)
+    assert not single.has("compress_inter")
+    assert not single.has("is_hierarchical_reduce")
+    assert single.has("compress_intra")
+    assert "compress_intra" not in single.conditions
+
+
+def test_goodput_outranks_speed():
+    """Two-config fixture: the non-hierarchical config steps 2x faster but
+    compile-churns half its wall time away (goodput 0.5); the hierarchical
+    one is slower but productive (goodput 0.9).  The legacy speed score
+    would pick the churner — the v2 fleet-min goodput score must pick the
+    slower-but-productive config."""
+    service, client, server = _v2_service_client(max_samples=20)
+    try:
+        decls = [t.model_dump() for t in tensor_list(n=8, numel=1000)]
+        rsp = client.register_tensors("gp", decls, capabilities=V2_CAPS)
+        task = service._task("gp")
+        assert task.manager.space is not None, "capabilities must build v2"
+        hp = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
+        for it in range(1, 60):
+            hier = bool(hp.is_hierarchical_reduce)
+            speed = 100.0 if hier else 200.0
+            goodput = 0.9 if hier else 0.5
+            client.report_metrics(
+                "gp", 0, it, hp.model_dump(), speed,
+                obs={"goodput_fraction": goodput},
+            )
+            rsp = client.ask_hyperparameters("gp", 0, it)
+            hp = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
+            if rsp["is_autotune_completed"]:
+                break
+        assert rsp["is_autotune_completed"]
+        assert hp.is_hierarchical_reduce is True, (
+            "controller picked the fast-but-churning config over the "
+            "productive one"
+        )
+    finally:
+        server.shutdown()
+
+
+def test_speed_only_fallback_without_goodput_coverage():
+    """Without obs payloads the same fixture falls back to summed speed
+    (legacy trainers / obs plane off keep converging)."""
+    service, client, server = _v2_service_client(max_samples=16)
+    try:
+        decls = [t.model_dump() for t in tensor_list(n=8, numel=1000)]
+        rsp = client.register_tensors("sp", decls, capabilities=V2_CAPS)
+        hp = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
+        for it in range(1, 50):
+            speed = 200.0 if not hp.is_hierarchical_reduce else 100.0
+            client.report_metrics("sp", 0, it, hp.model_dump(), speed)
+            rsp = client.ask_hyperparameters("sp", 0, it)
+            hp = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
+            if rsp["is_autotune_completed"]:
+                break
+        assert rsp["is_autotune_completed"]
+        assert hp.is_hierarchical_reduce is False
+    finally:
+        server.shutdown()
+
+
+def test_compress_dcn_hint_is_prior_not_pin_on_v2():
+    """On a live v2 search the autopilot's DCN-compression hint primes a
+    search point + weights the coordinate — it must NOT pin
+    ``recommended.compress_inter`` (the measured goodput keeps the last
+    word)."""
+    service, client, server = _v2_service_client(max_samples=30)
+    try:
+        decls = [t.model_dump() for t in tensor_list(n=4, numel=100)]
+        client.register_tensors("pr", decls, capabilities=V2_CAPS)
+        task = service._task("pr")
+        client.report_metrics(
+            "pr", -1, 1, {}, 0.0,
+            perf_hints=[{"kind": "autopilot_compress_dcn",
+                         "codec": "minmax_uint8", "dcn_share": 0.4}],
+        )
+        # no pin...
+        assert task.recommended.compress_inter == ""
+        # ...but a warm-start prior carrying the codec under hierarchical
+        # reduce, plus exploit weighting toward the DCN codec coordinate
+        primed = task.manager.optimizer._primed
+        assert primed and primed[0]["compress_inter"] == "minmax_uint8"
+        assert primed[0]["is_hierarchical_reduce"] is True
+        assert task.manager.optimizer._coord_weights["compress_inter"] > 1.0
+        # the primed point is served by the next sampling round: drive
+        # rounds until a recommendation carries the codec
+        hp = task.recommended
+        carried = False
+        for it in range(1, 12):
+            client.report_metrics("pr", 0, it, hp.model_dump(), 100.0,
+                                  obs={"goodput_fraction": 0.8})
+            rsp = client.ask_hyperparameters("pr", 0, it)
+            hp = BaguaHyperparameter(**rsp["recommended_hyperparameters"])
+            if hp.compress_inter == "minmax_uint8" \
+                    and hp.is_hierarchical_reduce:
+                carried = True
+                break
+        assert carried, "primed prior never reached a recommendation"
+    finally:
+        server.shutdown()
+
+
+def test_invalid_hint_codec_stripped_at_ingest():
+    """An unknown codec in a hint is validated ONCE at ingest and
+    stripped: no pin, no prior — but the hint still lands (re-measure
+    semantics keep working)."""
+    service, client, server = _v2_service_client()
+    try:
+        decls = [t.model_dump() for t in tensor_list(n=4, numel=100)]
+        client.register_tensors("bad", decls, capabilities=V2_CAPS)
+        task = service._task("bad")
+        client.report_metrics(
+            "bad", -1, 1, {}, 0.0,
+            perf_hints=[{"kind": "autopilot_compress_dcn",
+                         "codec": "totally_bogus"}],
+        )
+        assert task.recommended.compress_inter == ""
+        assert not task.manager.optimizer._primed
+        assert task.perf_hints_total == 1  # hint itself was recorded
+        assert task.perf_hints[-1]["codec"] == ""  # normalized at ingest
+    finally:
+        server.shutdown()
+
+
+def test_anomaly_flagged_window_is_remeasured():
+    """A window whose obs payload carries the rank-local anomaly flag is
+    discarded like a hint-tainted one: the point re-measures once instead
+    of scoring the environment."""
+    service, client, server = _v2_service_client()
+    try:
+        decls = [t.model_dump() for t in tensor_list(n=4, numel=100)]
+        client.register_tensors("an", decls, capabilities=V2_CAPS)
+        task = service._task("an")
+        client.report_metrics("an", 0, 1, task.recommended.model_dump(),
+                              100.0, obs={"goodput_fraction": 0.9,
+                                          "anomaly": True})
+        client.ask_hyperparameters("an", 0, 1)
+        assert task.n_samples == 0 and task.sample_retried is True
+        # clean re-measure window scores normally
+        client.report_metrics("an", 0, 2, task.recommended.model_dump(),
+                              100.0, obs={"goodput_fraction": 0.9})
+        client.ask_hyperparameters("an", 0, 2)
+        assert task.n_samples == 1
+    finally:
+        server.shutdown()
+
+
 def test_autotune_level_zero_is_passthrough(service_client):
     service, client = service_client
     service.autotune_level = 0

@@ -55,6 +55,53 @@ def test_perf_fields_empty_analysis_is_silent():
     assert fields == {"timing": "min_of_2_windows_x10_steps"}
 
 
+def test_bench_autotune_artifact_schema():
+    """BENCH_AUTOTUNE.json (benchmarks/autotune_bench.py): the goodput-
+    scored v2 search must have completed within the 24-window cap with
+    every sample scored on goodput (one speed-scaled sample would poison
+    best() across scales), and the tuned-vs-default A/B must carry the
+    BENCH_FLAT-style honesty protocol — per-trial ratios + noise_bound —
+    with the tuned config no worse than the default (or provably noise)."""
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "BENCH_AUTOTUNE.json")
+    assert os.path.exists(path), "run benchmarks/autotune_bench.py first"
+    rec = json.load(open(path))
+    assert rec["schema"] == "bagua-autotune-bench-v1"
+    assert rec["platform"] == "cpu-sim" and rec["n_devices"] == 8
+
+    search = rec["search"]
+    assert search["completed"] is True
+    assert search["goodput_scored"] is True
+    assert search["window_cap"] == 24
+    assert 0 < search["n_windows"] <= search["window_cap"]
+    assert search["n_scored_samples"] >= 8
+    # fleet-min goodput + bounded speed tiebreak lives in [0, 1 + 1e-4]
+    assert search["score_trajectory"], search
+    assert all(0.0 <= s <= 1.0 + 1e-3 for s in search["score_trajectory"])
+    # the v2 space actually closed over the full knob set (two-tier mesh)
+    for knob in ("bucket_size_2p", "is_hierarchical_reduce", "overlap",
+                 "overlap_chunk_bytes_inter_2p", "compress_intra",
+                 "compress_inter"):
+        assert knob in search["space"], knob
+    for fld in ("bucket_size", "is_hierarchical_reduce", "overlap",
+                "compress_inter", "flat_resident"):
+        assert fld in search["recommended"], fld
+
+    ab = rec["ab"]
+    assert isinstance(ab["trials"], list) and len(ab["trials"]) >= 3
+    ratios = [r for r in ab["per_trial_goodput_ratios"] if r is not None]
+    assert len(ratios) >= 3
+    assert isinstance(ab["noise_bound"], bool)
+    acc = rec["acceptance"]
+    assert acc["n_windows_le_cap"] is True
+    assert acc["goodput_scored"] is True
+    assert acc["tuned_goodput_ge_baseline_or_noise_bound"] is True
+    assert ab["tuned_ge_baseline"] or ab["noise_bound"], ab
+
+
 def test_bench_flat_artifact_schema():
     """BENCH_FLAT.json (driver-visible artifact of bench.py --flat): the
     interleaved-A/B records must carry the full honesty protocol — the

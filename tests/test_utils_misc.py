@@ -1,6 +1,7 @@
 """Watchdog + version diagnostics tests (reference comm monitor lib.rs:255-265
 and show_version lib.rs:103-123)."""
 
+import math
 import time
 
 
@@ -34,3 +35,21 @@ def test_show_version():
 
     out = show_version()
     assert "bagua_tpu" in out and "jax" in out
+
+
+def test_statistical_average_bucket_count_is_bounded():
+    """Regression: record_seconds claimed 2^L for buckets covering only
+    2^L - 1 seconds, so record()'s regrow loop added one bucket on EVERY
+    call — after ~1000 train-step speed samples 2.0**i overflowed and
+    took down training."""
+    from bagua_tpu.utils import StatisticalAverage
+
+    sa = StatisticalAverage()
+    for _ in range(5000):
+        sa.record(100.0)  # back-to-back: elapsed ~ 0 each call
+    assert len(sa.records) < 16, len(sa.records)
+    assert sa.get(1.0) <= 100.0 * 1.01
+
+    # non-finite rates (a zero-dt dispatch window) must not poison means
+    sa.record(float("inf"))
+    assert math.isfinite(sa.get(1.0))
