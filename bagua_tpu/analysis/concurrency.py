@@ -57,6 +57,13 @@ _LOCK_CTORS = {
     "threading.RLock": True,
     "Lock": False,
     "RLock": True,
+    # a Condition IS a lock (it wraps an RLock by default and supports the
+    # same context-manager protocol); writes under ``with self._cond:`` are
+    # guarded writes.  ``.wait()`` releases the lock while blocking, and is
+    # deliberately absent from _IO_CALLS, so condition waits don't surface
+    # as lock-held-io false positives.
+    "threading.Condition": True,
+    "Condition": True,
 }
 
 #: constructor dotted names that spawn a background thread; the ``target``

@@ -313,16 +313,41 @@ class _GangFailure(Exception):
         self.code = code
 
 
+def _store_endpoints(args):
+    """Replicated restart-store endpoint list, or ``None`` (single-store
+    mode).  ``BAGUA_RESTART_STORE_ENDPOINTS`` (comma-separated host:port,
+    priority order — the boot primary first, standby replicas after) turns
+    the restart KV store into a replicated group with client failover and
+    standby-coordinator takeover (docs/robustness.md).  Unset, every code
+    path below is the unchanged single-store launcher."""
+    endpoints = _env.get_restart_store_endpoints()
+    if not endpoints:
+        return None
+    from ..elastic.failover import parse_endpoints
+
+    return parse_endpoints(endpoints)
+
+
 def _connect_restart_store(args, timeout_s: float = 60.0):
     """Client to node 0's restart KV store, with connect retries (peers may
     start before the server is up).  Retries use jittered exponential
     backoff: after a gang restart every node reconnects at the same
     instant, and a fixed-interval poll keeps them in lockstep hammering
     node 0's accept queue — the jitter de-synchronizes the herd and the
-    exponential cap bounds the total load."""
+    exponential cap bounds the total load.
+
+    With ``BAGUA_RESTART_STORE_ENDPOINTS`` set this returns a
+    :class:`~bagua_tpu.elastic.failover.FailoverStore` over the replica
+    group instead — same op surface, but ops survive the primary dying."""
     import random
 
     from ..contrib.utils.tcp_store import TCPStore
+
+    endpoints = _store_endpoints(args)
+    if endpoints is not None:
+        from ..elastic.failover import FailoverStore
+
+        return FailoverStore(endpoints, connect_timeout_s=timeout_s)
 
     deadline = time.time() + timeout_s
     delay = 0.1
@@ -357,18 +382,39 @@ def _connect_restart_store(args, timeout_s: float = 60.0):
             delay = min(delay * 2, 5.0)
 
 
+def _store_connect_factory(args):
+    """Connection factory for background store threads (lease keeper,
+    heartbeats): each thread opens its OWN client — one connection per
+    thread, never a socket shared across threads."""
+    return lambda: _connect_restart_store(args, timeout_s=10.0)
+
+
 class _RestartStore:
     """Reconnecting client: a transient socket error (timeout, reset) must
     not permanently blind a node to remote failures — each op retries once
-    on a fresh connection before giving up, logging which op it retried."""
+    on a fresh connection before giving up, logging which op it retried.
+
+    In replicated mode (``BAGUA_RESTART_STORE_ENDPOINTS``) the client is a
+    :class:`~bagua_tpu.elastic.failover.FailoverStore`, which already owns
+    retry, endpoint failover, the per-op deadline budget and the chaos
+    hooks — the retry-once wrapper would double-fire the ``store.op``
+    fault point, so ops pass straight through."""
 
     def __init__(self, args, connect_timeout_s: float = 60.0):
         self._args = args
+        self._failover = _store_endpoints(args) is not None
         self._client = _connect_restart_store(args, connect_timeout_s)
+
+    @property
+    def generation(self) -> int:
+        """Store generation the client last observed (0 single-store)."""
+        return getattr(self._client, "generation", 0)
 
     def _retry(self, opname, op):
         from ..faults import inject as _inject
 
+        if self._failover:
+            return op(self._client)
         try:
             _inject.maybe_raise_store_error(opname)  # chaos: store.op flake
             return op(self._client)
@@ -624,9 +670,155 @@ def publish_autopilot_stop(client, epoch: int, action, nodes) -> str:
     return reason
 
 
+def _build_coordinator_stack(args, store, client):
+    """Everything the coordinator role needs beyond plain membership:
+    rendezvous coordinator, autopilot engine, telemetry historian, the
+    fleet-record holder and the HTTP status plane.  ONE builder shared by
+    the boot-time coordinator and a promoted standby — the takeover path
+    constructs the exact stack the primary ran, and because the engine and
+    historian load their state from the (replicated) restart store at
+    construction, cooldowns/rungs/quarantines and trend windows RESUME on
+    the new coordinator instead of resetting.  Returns
+    ``(coordinator, autopilot, historian, fleet_holder, http_server)``."""
+    from ..elastic.coordinator import ElasticCoordinator
+
+    coordinator = ElasticCoordinator(
+        client, args.min_nnodes, args.max_nnodes,
+        args.master_addr, args.master_port,
+        join_window_s=args.join_window,
+        timeout_s=args.restart_barrier_timeout,
+    )
+    autopilot = None
+    if _env.get_autopilot_mode() != "off":
+        # ONE engine across every epoch of this coordinator's life; its
+        # policy state additionally persists through the restart store, so
+        # a RELAUNCHED (or takeover-promoted) coordinator resumes with
+        # cooldowns/rung/quarantines intact instead of re-firing a
+        # cooled-down action
+        from ..autopilot import AutopilotEngine, default_engine_actuators
+
+        autopilot = AutopilotEngine(
+            actuators=default_engine_actuators(
+                autotune_addr=(f"{args.master_addr}:"
+                               f"{args.bagua_service_port}"),
+            ),
+            store=store,
+        )
+        logger.info("fleet autopilot: %s mode", autopilot.config.mode)
+    # fleet telemetry historian (docs/observability.md): ONE set of
+    # time-series rings across every epoch, persisted through the restart
+    # store so a relaunched coordinator keeps its trend windows instead of
+    # re-earning them; a misconfigured knob degrades to "historian off"
+    # with a warning, never a dead coordinator
+    from ..obs.historian import maybe_build_historian
+
+    historian = maybe_build_historian(store=store)
+    if historian is not None:
+        logger.info("telemetry historian: on (window %.0fs, "
+                    "%d samples/series)", historian.window_s,
+                    historian.capacity)
+    fleet_holder = None
+    http_server = None
+    if _env.get_obs_http_port() > 0:
+        # HTTP status plane: the coordinator serves the fleet routes
+        # (/fleet from the latest monitor-tick merge, /history from the
+        # historian) on top of the per-process ones; workers start their
+        # own servers at bring-up on the build_env-offset ports.  On a
+        # promoted standby whose launcher already runs the global server,
+        # this re-attaches the fleet provider + historian to it — the
+        # takeover's /fleet + /history re-open.
+        from ..obs.http import maybe_start_global_http_server
+
+        fleet_holder = {"record": None}
+        http_server = maybe_start_global_http_server(
+            fleet_provider=lambda: fleet_holder["record"],
+            historian=historian,
+        )
+    return coordinator, autopilot, historian, fleet_holder, http_server
+
+
+class _PromotionHandle:
+    """Standby-launcher takeover state.
+
+    Owns the :class:`~bagua_tpu.elastic.failover.StandbyCoordinatorWatch`
+    (which runs the store election in the background) and, once the watch
+    wins, finishes the launcher-side half of the takeover:
+
+    1. build the full coordinator stack over the replicated store — the
+       autopilot engine and historian constructors load their persisted
+       state, so policy cooldowns and trend windows resume;
+    2. start renewing the leadership lease under OUR node id;
+    3. when promotion lands mid-epoch, hand back a
+       :class:`~bagua_tpu.elastic.membership.LeaseTracker` for the current
+       spec, RE-ARMED with a takeover grace window — a coordinator blip
+       must not mass-expire every healthy worker lease (their heartbeats
+       never stopped; it was the OBSERVER that went away)."""
+
+    def __init__(self, args, store, client, watch):
+        self.args = args
+        self.store = store
+        self.client = client
+        self.watch = watch
+        self.coordinator = None
+        self.autopilot = None
+        self.historian = None
+        self.fleet_holder = None
+        self.http_server = None
+        self.keeper = None
+        self.completed = False
+
+    @property
+    def pending(self) -> bool:
+        """The watch won the store election; the launcher-side takeover
+        has not happened yet."""
+        return not self.completed and self.watch.promoted
+
+    def complete(self, spec=None):
+        """Finish the takeover.  Returns the re-armed lease tracker for
+        ``spec`` (mid-epoch promotion), or None when promotion lands
+        between epochs and the next ``run_round`` builds the world anew."""
+        from ..elastic import membership as mb
+        from ..elastic.failover import CoordinatorLeaseKeeper
+
+        args = self.args
+        (self.coordinator, self.autopilot, self.historian,
+         self.fleet_holder, self.http_server) = _build_coordinator_stack(
+            args, self.store, self.client)
+        self.keeper = CoordinatorLeaseKeeper(
+            _store_connect_factory(args),
+            args.node_rank, _env.get_restart_coord_lease_ttl_s(),
+            generation=self.watch.store.generation,
+        ).start()
+        self.completed = True
+        logger.warning(
+            "coordinator takeover complete: node %d now runs the "
+            "coordinator (store generation %d)", args.node_rank,
+            self.watch.store.generation,
+        )
+        if spec is None:
+            return None
+        tracker = mb.LeaseTracker(
+            self.client, spec.epoch,
+            [i for i in spec.ranks if i != args.node_rank],
+            ttl_s=args.lease_ttl,
+            fence_unhealthy_after=(
+                _env.get_elastic_fence_unhealthy() or None
+            ),
+            observe_only_ids=[args.node_rank],
+        )
+        grace = _env.get_restart_takeover_grace_s() or 2.0 * args.lease_ttl
+        tracker.rearm(grace)
+        return tracker
+
+    def stop(self) -> None:
+        self.watch.stop()
+        if self.keeper is not None:
+            self.keeper.stop()
+
+
 def monitor_elastic(args, procs, client, spec, coordinator, tracker,
                     autopilot=None, historian=None,
-                    fleet_holder=None) -> int:
+                    fleet_holder=None, promotion=None) -> int:
     """Monitor one elastic attempt.  Every launcher: watch local workers +
     the per-epoch stop flag.  The coordinator additionally: expire silent
     members' leases, scan for standby joiners (scale-up requests) — each
@@ -638,6 +830,16 @@ def monitor_elastic(args, procs, client, spec, coordinator, tracker,
     epoch = spec.epoch
     store_down_since = None
     while True:
+        if promotion is not None and promotion.pending:
+            # the standby watch won the store election mid-epoch: become
+            # the coordinator IN PLACE — same spec, same workers, fresh
+            # tracker re-armed with the takeover grace so nobody healthy
+            # gets expired while heartbeats re-converge on us
+            tracker = promotion.complete(spec)
+            coordinator = promotion.coordinator
+            autopilot = promotion.autopilot
+            historian = promotion.historian
+            fleet_holder = promotion.fleet_holder
         codes = [p.poll() for p in procs]
         failed = [c for c in codes if c not in (None, 0)]
         if failed:
@@ -777,7 +979,6 @@ def run_elastic(args) -> int:
     from ..contrib.utils.tcp_store import TCPStoreServer
     from ..elastic import membership as mb
     from ..elastic.coordinator import (
-        ElasticCoordinator,
         ExcludedFromRound,
         Halted,
         RendezvousTimeout,
@@ -786,12 +987,33 @@ def run_elastic(args) -> int:
     )
     from ..telemetry import counters
 
-    is_coord = args.node_rank == 0
+    endpoints = _store_endpoints(args)
     server = None
     http_server = None
-    if is_coord:
-        server = TCPStoreServer(host="0.0.0.0",
-                                port=args.restart_coordinator_port)
+    keeper = None
+    promotion = None
+    if endpoints is None:
+        is_coord = args.node_rank == 0
+        if is_coord:
+            server = TCPStoreServer(host="0.0.0.0",
+                                    port=args.restart_coordinator_port)
+    else:
+        # replicated restart store (docs/robustness.md): the first
+        # len(endpoints) node ids each host one store server — id 0 boots
+        # as the primary, the rest as replication followers.  A RELAUNCHED
+        # id 0 probes its peers first (_recover_from_peers): it adopts the
+        # surviving replicated state and, if a takeover already moved the
+        # primary role, starts demoted — leadership is a lease in the
+        # store, not a property of the node id.
+        if args.node_rank < len(endpoints):
+            server = TCPStoreServer(
+                host="0.0.0.0", port=endpoints[args.node_rank][1],
+                peers=[e for i, e in enumerate(endpoints)
+                       if i != args.node_rank],
+                role="primary" if args.node_rank == 0 else "standby",
+            )
+        is_coord = args.node_rank == 0 and (server is None
+                                            or server.is_primary)
     transitions: List[dict] = []
     stop_counter = {
         mb.STOP_FAIL: "elastic/failures",
@@ -808,62 +1030,48 @@ def run_elastic(args) -> int:
         historian = None
         fleet_holder = None
         if is_coord:
-            coordinator = ElasticCoordinator(
-                client, args.min_nnodes, args.max_nnodes,
-                args.master_addr, args.master_port,
-                join_window_s=args.join_window,
-                timeout_s=args.restart_barrier_timeout,
+            (coordinator, autopilot, historian, fleet_holder,
+             http_server) = _build_coordinator_stack(args, store, client)
+        if endpoints is not None:
+            from ..elastic.failover import (
+                CoordinatorLeaseKeeper,
+                StandbyCoordinatorWatch,
             )
-            if _env.get_autopilot_mode() != "off":
-                # ONE engine across every epoch of this coordinator's
-                # life; its policy state additionally persists through the
-                # restart store, so a RELAUNCHED coordinator resumes with
-                # cooldowns/rung/quarantines intact instead of re-firing a
-                # cooled-down action
-                from ..autopilot import (
-                    AutopilotEngine,
-                    default_engine_actuators,
-                )
 
-                autopilot = AutopilotEngine(
-                    actuators=default_engine_actuators(
-                        autotune_addr=(f"{args.master_addr}:"
-                                       f"{args.bagua_service_port}"),
-                    ),
-                    store=store,
-                )
-                logger.info("fleet autopilot: %s mode",
-                            autopilot.config.mode)
-            # fleet telemetry historian (docs/observability.md): ONE set
-            # of time-series rings across every epoch, persisted through
-            # the restart store so a relaunched coordinator keeps its
-            # trend windows instead of re-earning them; a misconfigured
-            # knob degrades to "historian off" with a warning, never a
-            # dead coordinator
-            from ..obs.historian import maybe_build_historian
-
-            historian = maybe_build_historian(store=store)
-            if historian is not None:
-                logger.info("telemetry historian: on (window %.0fs, "
-                            "%d samples/series)", historian.window_s,
-                            historian.capacity)
-            if _env.get_obs_http_port() > 0:
-                # HTTP status plane: the coordinator serves the fleet
-                # routes (/fleet from the latest monitor-tick merge,
-                # /history from the historian) on top of the per-process
-                # ones; workers start their own servers at bring-up on
-                # the build_env-offset ports
-                from ..obs.http import maybe_start_global_http_server
-
-                fleet_holder = {"record": None}
-                http_server = maybe_start_global_http_server(
-                    fleet_provider=lambda: fleet_holder["record"],
-                    historian=historian,
+            coord_ttl = _env.get_restart_coord_lease_ttl_s()
+            if is_coord:
+                keeper = CoordinatorLeaseKeeper(
+                    _store_connect_factory(args),
+                    args.node_rank, coord_ttl,
+                    generation=store.generation,
+                ).start()
+            elif server is not None:
+                # standby coordinator: every follower-store host watches
+                # the leadership lease from its own connection; the watch
+                # wins the takeover in the STORE (generation fence), the
+                # _PromotionHandle finishes the launcher side
+                promotion = _PromotionHandle(
+                    args, store, client,
+                    StandbyCoordinatorWatch(
+                        _connect_restart_store(args, timeout_s=60.0),
+                        args.node_rank, args.node_rank, coord_ttl,
+                    ).start(),
                 )
         epoch = 0
         restarts_used = 0
         expect = None
         while True:
+            if promotion is not None and promotion.completed \
+                    and not is_coord:
+                # takeover landed (mid-epoch in monitor_elastic, or while
+                # waiting out a dead primary below): this launcher runs
+                # every round from here on as the coordinator
+                is_coord = True
+                coordinator = promotion.coordinator
+                autopilot = promotion.autopilot
+                historian = promotion.historian
+                fleet_holder = promotion.fleet_holder
+                http_server = promotion.http_server
             try:
                 from ..obs.spans import trace_span
 
@@ -871,11 +1079,30 @@ def run_elastic(args) -> int:
                                 role="coordinator" if is_coord else "member"):
                     if is_coord:
                         spec = coordinator.run_round(epoch, expect=expect)
-                    else:
+                    elif promotion is None:
                         spec = join_round(
                             client, epoch,
                             timeout_s=args.restart_barrier_timeout,
                         )
+                        epoch = spec.epoch
+                    else:
+                        # a standby-store host must not sit out the whole
+                        # rendezvous timeout inside join_round: when the
+                        # primary dies mid-rendezvous the watch promotes
+                        # US, and only the promoted node can publish the
+                        # epoch everyone (including us) is waiting for —
+                        # so wait in short slices and surface promotion
+                        deadline = time.monotonic() + \
+                            args.restart_barrier_timeout
+                        while True:
+                            try:
+                                spec = join_round(client, epoch,
+                                                  timeout_s=5.0)
+                                break
+                            except RendezvousTimeout:
+                                if promotion.pending or \
+                                        time.monotonic() > deadline:
+                                    raise
                         epoch = spec.epoch
             except ExcludedFromRound as e:
                 logger.warning("%s", e)
@@ -895,6 +1122,14 @@ def run_elastic(args) -> int:
                 logger.info("job already decided: %s", h)
                 return int(h.verdict.get("code", 1))
             except (RendezvousTimeout, *_STORE_RETRY_ERRORS) as e:
+                if promotion is not None and promotion.pending:
+                    logger.warning(
+                        "rendezvous interrupted at epoch %d (%s); this "
+                        "standby was promoted — rerunning the round as "
+                        "the coordinator", epoch, e,
+                    )
+                    promotion.complete()
+                    continue
                 logger.error("rendezvous failed at epoch %d: %s", epoch, e)
                 if is_coord:
                     try:
@@ -923,7 +1158,7 @@ def run_elastic(args) -> int:
                 except OSError:
                     pass
             hb = mb.LeaseHeartbeat(
-                lambda: _connect_restart_store(args, timeout_s=10.0),
+                _store_connect_factory(args),
                 args.node_rank, spec.epoch,
                 interval_s=max(0.5, args.lease_ttl / 5.0),
                 max_nnodes=args.max_nnodes,
@@ -959,10 +1194,13 @@ def run_elastic(args) -> int:
                 rc = monitor_elastic(
                     args, procs, client, spec, coordinator, tracker,
                     autopilot=autopilot, historian=historian,
-                    fleet_holder=fleet_holder)
+                    fleet_holder=fleet_holder, promotion=promotion)
                 try:
                     client.publish_done(spec.epoch)
-                    if is_coord:
+                    # a takeover during the FINAL epoch makes us the
+                    # coordinator mid-monitor: the teardown duty moved too
+                    if is_coord or (promotion is not None
+                                    and promotion.completed):
                         # keep the store alive until every member's monitor
                         # stopped polling it, then post the verdict
                         deadline = time.time() + 30.0
@@ -1059,6 +1297,14 @@ def run_elastic(args) -> int:
                 hb.stop()
     finally:
         _dump_elastic_telemetry(transitions)
+        if keeper is not None:
+            keeper.stop()
+        if promotion is not None:
+            promotion.stop()
+            if http_server is None:
+                # promoted mid-epoch and exited before the loop top
+                # refreshed the local: the takeover's server still runs
+                http_server = promotion.http_server
         if http_server is not None:
             http_server.stop()
         if server is not None:

@@ -28,6 +28,11 @@ Modules:
   renegotiated ``BAGUA_*`` env, drive
   :meth:`~bagua_tpu.checkpoint.BaguaCheckpointManager.try_restore` onto the
   new topology, re-split the data shard.
+* :mod:`.failover` — coordinator failover: multi-endpoint store client
+  with generation-fenced failover (``BAGUA_RESTART_STORE_ENDPOINTS``),
+  the coordinator leadership lease, and the standby watch that promotes a
+  follower store + takes the coordinator role over when the primary dies
+  (docs/robustness.md).
 """
 
 from .membership import (  # noqa: F401
@@ -51,3 +56,12 @@ from .coordinator import (  # noqa: F401
     wait_for_next_epoch,
 )
 from .resize import ElasticContext, elastic_restore, shard_bounds  # noqa: F401
+from .failover import (  # noqa: F401
+    CoordinatorLeaseKeeper,
+    FailoverStore,
+    StandbyCoordinatorWatch,
+    StoreOpDeadlineError,
+    parse_endpoints,
+    read_coord_lease,
+    write_coord_lease,
+)

@@ -499,9 +499,28 @@ class LeaseTracker:
             if int(i) not in self._leases
         ]
         self._health: Dict[int, dict] = {}
+        self._grace_until = 0.0
         if fence_unhealthy_after is not None and fence_unhealthy_after < 1:
             fence_unhealthy_after = None
         self._fence_unhealthy_after = fence_unhealthy_after
+
+    def rearm(self, grace_s: Optional[float] = None) -> None:
+        """Re-arm every member lease against THIS tracker's clock.
+
+        A promoted standby coordinator calls this at takeover: the dead
+        primary's lease timestamps died with its process, and the members'
+        heartbeats spent the failover window retrying against a fenced
+        store — judging their last-seen sequence numbers as ``ttl_s`` old
+        would mass-expire a perfectly healthy fleet.  Re-arming stamps
+        every lease ``now`` and (with ``grace_s > ttl_s``) additionally
+        suspends expiry until the takeover grace window has passed, giving
+        queued heartbeats time to drain to the promoted store."""
+        now = time.monotonic()
+        for lease in self._leases.values():
+            lease.changed_at = now
+        if grace_s is not None and grace_s > self._ttl_s:
+            self._grace_until = now + float(grace_s)
+        _counters.incr("elastic/lease_rearms", len(self._leases))
 
     def poll(self) -> List[int]:
         """One scan; returns member ids whose lease has expired."""
@@ -514,6 +533,7 @@ class LeaseTracker:
             if health is not None:
                 self._health[node_id] = health
         expired = []
+        in_grace = now < self._grace_until
         for node_id, lease in self._leases.items():
             seq, health = beats.get(node_id, (None, None))
             if health is not None:
@@ -521,7 +541,7 @@ class LeaseTracker:
             if seq is not None and seq != lease.seq:
                 lease.seq = seq
                 lease.changed_at = now
-            elif now - lease.changed_at > self._ttl_s:
+            elif not in_grace and now - lease.changed_at > self._ttl_s:
                 expired.append(node_id)
         return expired
 
@@ -542,7 +562,9 @@ class LeaseTracker:
         ]
 
     def expire_now(self, node_id: int) -> None:
-        """Force-expire (test hook / explicit eviction)."""
+        """Force-expire (test hook / explicit eviction); overrides any
+        takeover grace window."""
+        self._grace_until = 0.0
         self._leases[node_id].changed_at = -float("inf")
 
 
@@ -554,22 +576,38 @@ def publish_leave_intent(reason: str, timeout_s: float = 2.0) -> bool:
     Bounded and exception-free: the caller is about to die and must not be
     delayed by a gone store."""
     addr = _env.get_elastic_store_addr()
-    if not addr:
+    endpoints = _env.get_restart_store_endpoints()
+    if not addr and not endpoints:
         return False
     try:
         from ..contrib.utils.tcp_store import TCPStore
 
-        host, port = addr.rsplit(":", 1)
         epoch = _env.get_elastic_epoch()
         node_id = _env.get_elastic_node_id()
-        store = TCPStore(host, int(port), timeout_s=timeout_s)
-        try:
-            store.set(_k_leave(epoch, node_id), reason)
-        finally:
+        if endpoints:
+            # replicated restart store: the primary may be mid-takeover
+            # exactly when we are departing — the failover client walks
+            # the endpoint list (and follows a fenced write to the new
+            # primary) within the same bounded budget
+            from .failover import FailoverStore
+
+            store = FailoverStore(endpoints, connect_timeout_s=timeout_s,
+                                  op_deadline_s=timeout_s,
+                                  client_timeout_s=timeout_s)
             try:
-                store._sock.close()
-            except OSError:
-                pass
+                store.set(_k_leave(epoch, node_id), reason)
+            finally:
+                store.close()
+        else:
+            host, port = addr.rsplit(":", 1)
+            store = TCPStore(host, int(port), timeout_s=timeout_s)
+            try:
+                store.set(_k_leave(epoch, node_id), reason)
+            finally:
+                try:
+                    store._sock.close()
+                except OSError:
+                    pass
         logger.info("published leave intent (node %d, epoch %d): %s",
                     node_id, epoch, reason)
         return True

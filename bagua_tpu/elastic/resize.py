@@ -33,6 +33,11 @@ class ElasticContext:
     min_nnodes: int
     max_nnodes: int
     store_addr: Optional[str]
+    # replicated restart store, comma-separated host:port (empty in
+    # single-store mode): worker-side store writers (leave intent, drill
+    # verdicts) should prefer this over ``store_addr`` so they survive a
+    # coordinator takeover happening underneath them
+    store_endpoints: str = ""
 
     @classmethod
     def from_env(cls) -> "ElasticContext":
@@ -48,6 +53,7 @@ class ElasticContext:
             min_nnodes=int(e.get("BAGUA_ELASTIC_MIN_NNODES", "1")),
             max_nnodes=int(e.get("BAGUA_ELASTIC_MAX_NNODES", str(world))),
             store_addr=e.get("BAGUA_ELASTIC_STORE_ADDR"),
+            store_endpoints=e.get("BAGUA_RESTART_STORE_ENDPOINTS", ""),
         )
 
     def init_process_group(self, **kwargs):

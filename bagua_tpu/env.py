@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
 
 
 # ---- registry ------------------------------------------------------------
@@ -231,6 +231,29 @@ _declare("BAGUA_ELASTIC_HEALTH_FILE", "str", "",
          "async-staleness event counters are published here; the launcher "
          "merges all local beacons and carries them on its lease heartbeat "
          "to the coordinator as a health payload.")
+# -- restart-store replication / coordinator failover (docs/robustness.md) --
+_declare("BAGUA_RESTART_STORE_ENDPOINTS", "str", "",
+         "Comma-separated ``host:port`` list (priority order) of replicated "
+         "restart-store endpoints.  Entry 0 is the initial primary; later "
+         "entries are standby followers the primary streams its op log to, "
+         "and the clients fail over to (promoting the first reachable one) "
+         "when the primary dies.  Empty = the single coordinator-hosted "
+         "store, byte-identical to the pre-replication path.")
+_declare("BAGUA_RESTART_STORE_OP_DEADLINE_S", "float", "45",
+         "Total retry budget (seconds) for one restart-store op across "
+         "reconnects and endpoint failovers; exhausting it raises instead "
+         "of retrying forever inside watchdog sections.  0 disables the "
+         "budget (the pre-failover unbounded behavior).")
+_declare("BAGUA_RESTART_COORD_LEASE_TTL_S", "float", "5",
+         "Coordinator leadership lease TTL: the active coordinator renews "
+         "a lease key in the (replicated) restart store at TTL/3; a "
+         "standby that sees no renewal for a full TTL on its own clock "
+         "promotes the store and takes the coordinator role over.")
+_declare("BAGUA_RESTART_TAKEOVER_GRACE_S", "float", "0",
+         "Grace window after a coordinator takeover during which member "
+         "leases are re-armed rather than expired (heartbeats queued "
+         "against the dead primary need time to drain to the promoted "
+         "store).  0 = auto: 2x BAGUA_ELASTIC_LEASE_TTL_S.")
 # -- observability plane (docs/observability.md) --
 _declare("BAGUA_OBS", "enum", "on",
          "Unified observability plane master switch: step-span tracing, the "
@@ -1066,6 +1089,27 @@ def get_scale_dcn_codec() -> str:
 
 def get_elastic_store_addr() -> Optional[str]:
     return _raw("BAGUA_ELASTIC_STORE_ADDR")
+
+
+def get_restart_store_endpoints() -> List[str]:
+    """Priority-ordered ``host:port`` endpoints of the replicated restart
+    store; empty list = single-store mode (no replication, no failover)."""
+    raw = _raw("BAGUA_RESTART_STORE_ENDPOINTS") or ""
+    return [part.strip() for part in raw.split(",") if part.strip()]
+
+
+def get_restart_store_op_deadline_s() -> float:
+    return env_float("BAGUA_RESTART_STORE_OP_DEADLINE_S")
+
+
+def get_restart_coord_lease_ttl_s() -> float:
+    return env_float("BAGUA_RESTART_COORD_LEASE_TTL_S")
+
+
+def get_restart_takeover_grace_s() -> float:
+    """Post-takeover lease re-arm grace; 0 = auto (2x the member lease
+    TTL, resolved by the caller who knows the effective TTL)."""
+    return env_float("BAGUA_RESTART_TAKEOVER_GRACE_S")
 
 
 def get_elastic_epoch() -> int:

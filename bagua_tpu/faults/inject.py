@@ -47,6 +47,11 @@ Points and what firing them does:
                         slice named by ``rank`` for ``duration_s`` seconds —
                         intra-slice traffic keeps flowing, like a real
                         inter-slice network cut
+``store.failover``      the failover store client treats its *current*
+                        endpoint as dead before the next op — forces an
+                        endpoint failover (+ standby promotion) without
+                        killing the server process, so drills can prove the
+                        multi-endpoint client path deterministically
 ======================  =====================================================
 
 Every armed/fired/recovered event lands in
@@ -85,6 +90,7 @@ FAULT_POINTS = (
     "step.straggle",
     "async.partition",
     "podsim.link",
+    "store.failover",
 )
 
 #: default fault kind per point (the only kind most points support)
@@ -98,6 +104,7 @@ _DEFAULT_KINDS = {
     "step.straggle": "dilate",
     "async.partition": "drop",
     "podsim.link": "drop",
+    "store.failover": "error",
 }
 
 _VALID_KINDS = {
@@ -110,6 +117,7 @@ _VALID_KINDS = {
     "step.straggle": ("dilate",),
     "async.partition": ("drop",),
     "podsim.link": ("drop", "partition"),
+    "store.failover": ("error",),
 }
 
 
@@ -390,13 +398,15 @@ def note_traced_fire(spec: FaultSpec) -> None:
         plan.note_traced_fire(spec)
 
 
-def maybe_raise_store_error(opname: str) -> None:
-    """``store.op`` hook (``_RestartStore._retry``): raise a retryable
-    injected flake before the op runs."""
-    spec = should_fire("store.op")
+def maybe_raise_store_error(opname: str, point: str = "store.op") -> None:
+    """``store.op`` / ``store.failover`` hook (the failover store client):
+    raise a retryable injected flake before the op runs.  ``store.failover``
+    is queried on the op *after* reconnect too, so arming it with
+    ``count > 1`` walks the client down the endpoint list."""
+    spec = should_fire(point)
     if spec is not None:
         raise InjectedStoreError(
-            f"injected store fault on {opname} (seed={spec.seed})"
+            f"injected {point} fault on {opname} (seed={spec.seed})"
         )
 
 

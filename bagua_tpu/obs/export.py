@@ -163,6 +163,26 @@ _declare("elastic/health_fenced", "counter",
 _declare("elastic/restarts", "counter", "Elastic gang restarts consumed.")
 _declare("elastic/excluded", "counter",
          "Rounds this node was excluded from (waited as standby).")
+_declare("elastic/lease_rearms", "counter",
+         "Member leases re-armed at coordinator takeover (the promotion "
+         "grace that prevents a coordinator blip from mass-expiring "
+         "healthy workers).")
+# -- replicated restart store / coordinator failover --
+_declare("store/failovers", "counter",
+         "Restart-store client failovers to another endpoint (the previous "
+         "endpoint died, wedged, or answered with a write fence).")
+_declare("store/op_deadline_exceeded", "counter",
+         "Restart-store ops abandoned because the per-op retry deadline "
+         "budget (BAGUA_RESTART_STORE_OP_DEADLINE_S) was exhausted.")
+_declare("store/fenced_writes", "counter",
+         "Writes refused by a demoted/standby store server (generation "
+         "fence) as observed by this client.")
+_declare("store/promotions", "counter",
+         "Store-generation promotions this client performed (bumping a "
+         "standby endpoint to primary during failover).")
+_declare("coord/takeovers", "counter",
+         "Standby coordinator promotions to the active coordinator role "
+         "after the leadership lease went stale.")
 # -- fault injection (one armed/fired/recovered triple per point) --
 for _point in FAULT_POINTS:
     _declare(f"faults/{_point}/armed", "counter",

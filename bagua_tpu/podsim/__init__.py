@@ -25,6 +25,12 @@ measurable contract at 32-256 *real OS processes* on one host:
 * :mod:`~bagua_tpu.podsim.worker` — one simulated node: joins the REAL
   elastic-membership rendezvous, heartbeats a REAL lease, runs the
   shaped data plane, follows stop/resize/halt fences.
+* :mod:`~bagua_tpu.podsim.coordinator` — the coordinator stack as a
+  *killable OS process*: hosts one replica of the restart store, holds
+  (or stands by for) the leadership lease, and on takeover resumes
+  historian/autopilot state from the surviving replica —
+  ``scripts/failover_drill.py`` SIGKILLs it mid-training to prove
+  coordinator failover.
 * :mod:`~bagua_tpu.podsim.orchestrator` — plays every node's launcher at
   once: hosts the restart TCPStore, runs the real
   :class:`~bagua_tpu.elastic.coordinator.ElasticCoordinator` /

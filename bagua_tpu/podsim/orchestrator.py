@@ -43,10 +43,39 @@ from ..obs.http import ObsHTTPServer
 
 logger = logging.getLogger("podsim.orchestrator")
 
-__all__ = ["PodSim"]
+__all__ = ["PodSim", "worker_argv", "WORKER_PATH", "COORDINATOR_PATH"]
 
-_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "worker.py")
+WORKER_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "worker.py")
+_WORKER = WORKER_PATH
+#: the killable coordinator process (failover drills); PodSim itself runs
+#: the coordinator in-process — see :mod:`bagua_tpu.podsim.coordinator`
+COORDINATOR_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "coordinator.py")
+
+
+def worker_argv(store_addr: str, store_port: int, node_id: int,
+                max_nnodes: int, *, steps: int = 0, vec_elems: int = 16384,
+                shape: str = "pod", slice_size: int = 8, seed: int = 0,
+                dcn_codec: str = "minmax_uint8", hb_interval_s: float = 0.5,
+                timeout_s: float = 120.0,
+                store_endpoints: str = "") -> List[str]:
+    """The ``worker.py`` command line — ONE builder for the in-process
+    :class:`PodSim` launcher and the cross-process failover drill, so a
+    drill worker is configured exactly like a scale-drill worker."""
+    argv = [
+        sys.executable, WORKER_PATH,
+        "--store-addr", store_addr, "--store-port", str(store_port),
+        "--node-id", str(node_id), "--max-nnodes", str(max_nnodes),
+        "--steps", str(steps), "--vec-elems", str(vec_elems),
+        "--shape", shape, "--slice-size", str(slice_size),
+        "--seed", str(seed), "--dcn-codec", dcn_codec,
+        "--hb-interval", str(hb_interval_s),
+        "--timeout", str(timeout_s),
+    ]
+    if store_endpoints:
+        argv += ["--store-endpoints", store_endpoints]
+    return argv
 
 
 class PodSim:
@@ -123,16 +152,13 @@ class PodSim:
     def spawn(self, node_id: int) -> subprocess.Popen:
         env = dict(os.environ)
         env.update(self.worker_env)
-        argv = [
-            sys.executable, _WORKER,
-            "--store-addr", self.addr, "--store-port", str(self.port),
-            "--node-id", str(node_id), "--max-nnodes", str(self.world),
-            "--steps", str(self.steps), "--vec-elems", str(self.vec_elems),
-            "--shape", self.shape, "--slice-size", str(self.slice_size),
-            "--seed", str(self.seed), "--dcn-codec", self.dcn_codec,
-            "--hb-interval", str(self.hb_interval_s),
-            "--timeout", str(self.timeout_s),
-        ]
+        argv = worker_argv(
+            self.addr, self.port, node_id, self.world,
+            steps=self.steps, vec_elems=self.vec_elems, shape=self.shape,
+            slice_size=self.slice_size, seed=self.seed,
+            dcn_codec=self.dcn_codec, hb_interval_s=self.hb_interval_s,
+            timeout_s=self.timeout_s,
+        )
         log = open(self.log_path(node_id), "ab")
         try:
             proc = subprocess.Popen(

@@ -71,6 +71,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--store-addr", default="127.0.0.1")
     ap.add_argument("--store-port", type=int, required=True)
+    ap.add_argument("--store-endpoints", default="",
+                    help="comma-separated host:port replica group; when "
+                         "set, every store client this worker opens is a "
+                         "FailoverStore over the group — ops survive the "
+                         "primary store dying mid-epoch (the coordinator-"
+                         "failover drill)")
     ap.add_argument("--node-id", type=int, required=True)
     ap.add_argument("--max-nnodes", type=int, required=True)
     ap.add_argument("--steps", type=int, default=0,
@@ -87,7 +93,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     return ap.parse_args(argv)
 
 
-def _connect_store(args, timeout_s: float = 30.0) -> TCPStore:
+def _connect_store(args, timeout_s: float = 30.0):
+    if args.store_endpoints:
+        from bagua_tpu.elastic.failover import FailoverStore
+
+        return FailoverStore(
+            [e.strip() for e in args.store_endpoints.split(",")
+             if e.strip()],
+            connect_timeout_s=timeout_s,
+        )
     deadline = time.monotonic() + timeout_s
     while True:
         try:
@@ -205,7 +219,11 @@ def _data_plane(args, store, spec: WorldSpec, state: dict) -> dict:
 def _run_epoch(args, store, client: MembershipClient,
                spec: WorldSpec, state: dict) -> str:
     hb = LeaseHeartbeat(
-        lambda: TCPStore(args.store_addr, args.store_port, timeout_s=30.0),
+        # failover mode: the heartbeat's own connection must also walk the
+        # endpoint list, or a dead primary silently kills every lease
+        (lambda: _connect_store(args))
+        if args.store_endpoints else
+        (lambda: TCPStore(args.store_addr, args.store_port, timeout_s=30.0)),
         args.node_id, spec.epoch, interval_s=args.hb_interval,
         max_nnodes=args.max_nnodes,
         health_source=lambda: _health(args, state),

@@ -162,6 +162,19 @@ echo "=== scale smoke (4-process loopback pod drill) ==="
 # regenerate it with `python scripts/scale_drill.py`.
 timeout -k 10 120 python scripts/scale_drill.py --smoke > /dev/null
 
+echo "=== failover smoke (SIGKILL the live coordinator process) ==="
+# Coordinator failover end to end with REAL processes: a replicated
+# restart store (primary + follower servers, op-log replication,
+# generation fence), a killable coordinator renewing the leadership
+# lease, a standby watching it, and 4 workers mid-collective.  The drill
+# SIGKILLs the primary and asserts the standby promotes within the
+# member lease TTL, ZERO workers restart, and the autopilot/historian
+# state RESUMES from the replicated store.  The committed 32-rank fault
+# matrix (FAILOVER_DRILL.json) is schema-gated in
+# tests/test_bench_sanity.py; regenerate with
+# `python scripts/failover_drill.py`.
+timeout -k 10 150 python scripts/failover_drill.py --smoke > /dev/null
+
 echo "=== compressed-ring smoke (1-bit EF codec over the loopback pod) ==="
 # The stateful ISSUE-17 wire format end to end over real sockets: the same
 # 4-process drill with the DCN stage forced onto bit-packed sign payloads

@@ -97,6 +97,7 @@ MODULES = [
     "bagua_tpu.distributed.run",
     "bagua_tpu.elastic.membership",
     "bagua_tpu.elastic.coordinator",
+    "bagua_tpu.elastic.failover",
     "bagua_tpu.elastic.resize",
     "bagua_tpu.script.baguarun",
     "bagua_tpu.analysis",

@@ -335,6 +335,45 @@ def test_scale_bench_artifact_schema():
     assert record["ok"] is True
 
 
+def test_failover_drill_artifact_schema():
+    """FAILOVER_DRILL.json (driver-visible artifact of
+    scripts/failover_drill.py): the committed record must show the primary
+    coordinator SIGKILLed mid-training at >= 32 ranks with the standby
+    promoting inside the member lease TTL, ZERO healthy workers
+    restarting, autopilot/historian state resuming (not resetting), plus
+    the partition double-primary fence, armed store flakes, and member
+    lease expiry all green (regenerate with
+    `python scripts/failover_drill.py`)."""
+    import json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "FAILOVER_DRILL.json")
+    assert os.path.exists(path), "run scripts/failover_drill.py first"
+    record = json.load(open(path))
+    assert record["schema"] == "bagua-failover-drill-v1"
+    assert record["drill"] == "failover" and record["platform"] == "cpu-sim"
+    scenarios = record["scenarios"]
+    assert {"coordinator_failover", "partition_fence", "store_flake",
+            "heartbeat_loss"} <= set(scenarios)
+    kill = scenarios["coordinator_failover"]
+    # the headline claim: a 32-rank fleet survives its coordinator dying
+    assert kill["world"] >= 32
+    assert 0 < kill["takeover_s"] <= kill["member_lease_ttl_s"]
+    assert kill["checks"]["zero_worker_restarts"] is True
+    assert kill["checks"]["no_stop_event"] is True
+    assert kill["checks"]["epoch_unchanged"] is True
+    assert kill["checks"]["autopilot_state_resumed"] is True
+    assert kill["checks"]["historian_rings_resumed"] is True
+    # the double-primary row: the thawed ex-primary must exit DEMOTED
+    part = scenarios["partition_fence"]
+    assert part["ex_primary_exit"] == 5
+    assert part["checks"]["lease_stays_with_standby"] is True
+    for name, ok in record["checks"].items():
+        assert ok is True, name
+    assert record["ok"] is True
+
+
 def test_chaos_drill_artifact_schema():
     """CHAOS_DRILL.json (driver-visible artifact of scripts/chaos_drill.py):
     the committed record must cover the full fault matrix with every fault
